@@ -1,0 +1,26 @@
+"""generativeaiexamples_tpu — a TPU-native RAG framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capability surface of
+NVIDIA's GenerativeAIExamples RAG suite (reference: /root/reference):
+a streaming chain-server REST API, pluggable RAG pipelines, a config
+system, tracing, and an evaluation harness — with every external GPU
+engine (TensorRT-LLM/Triton NIM, NeMo Retriever, Milvus GPU index)
+replaced by TPU-native services built on jax.sharding/pjit/Pallas.
+
+Subpackages
+-----------
+config      dataclass config tree + YAML/JSON + APP_* env merge
+models      llama-family decoder, BERT-class embedder, cross-encoder (pure JAX)
+ops         Pallas/TPU kernels: flash attention, paged decode, MIPS top-k
+parallel    device mesh (ICI x DCN), sharding rules, collectives
+serving     KV cache, continuous batching engine, OpenAI-compatible server
+training    sharded SFT/LoRA trainer (optax)
+rag         splitters, vector stores, retriever, prompts
+connectors  LLM/embedding clients (local engine or any OpenAI-compatible URL)
+api         chain server: /generate (SSE), /documents, /search, /health
+pipelines   the example pipelines (QA RAG, multi-turn, agent, CSV, multimodal, chat)
+obs         OpenTelemetry tracing + serving metrics
+eval        RAGAS-style metrics + LLM-judge harness + synthetic QA generation
+"""
+
+__version__ = "0.1.0"

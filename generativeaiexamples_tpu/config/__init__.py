@@ -1,0 +1,32 @@
+from .schema import (
+    AppConfig,
+    EmbeddingConfig,
+    EngineConfig,
+    LLMConfig,
+    MeshConfig,
+    PromptsConfig,
+    RerankerConfig,
+    RetrieverConfig,
+    TextSplitterConfig,
+    TracingConfig,
+    VectorStoreConfig,
+)
+from .wizard import config_from_env, get_config, load_config, print_config_help
+
+__all__ = [
+    "AppConfig",
+    "EmbeddingConfig",
+    "EngineConfig",
+    "LLMConfig",
+    "MeshConfig",
+    "PromptsConfig",
+    "RerankerConfig",
+    "RetrieverConfig",
+    "TextSplitterConfig",
+    "TracingConfig",
+    "VectorStoreConfig",
+    "config_from_env",
+    "get_config",
+    "load_config",
+    "print_config_help",
+]

@@ -1,0 +1,203 @@
+"""Config schema: one frozen-dataclass tree for the whole framework.
+
+Capability parity with the reference's config system
+(RetrievalAugmentedGeneration/common/configuration.py:20-258 — sections
+vector_store / llm / text_splitter / embeddings / retriever / prompts),
+extended with TPU-native sections the reference delegates to external
+engines: `mesh` (device-mesh / parallelism layout) and `engine`
+(serving-engine knobs: KV paging, batching, dtypes).
+
+Every field can be overridden by an environment variable named
+``APP_<SECTION>_<FIELD>`` (e.g. ``APP_LLM_MODELNAME``,
+``APP_VECTORSTORE_URL``) — same contract as the reference
+(configuration_wizard.py:45,138) so existing deploy env files translate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class VectorStoreConfig:
+    """Vector store selection and index tuning.
+
+    Parity: configuration.py:20-47 (name/url/nlist/nprobe). The TPU build
+    adds the in-process stores ("memory", "tpu", "native") that replace the
+    reference's Milvus-GPU dependency (docker-compose-vectordb.yaml:57).
+    """
+
+    name: str = "memory"  # memory | tpu | native | milvus | pgvector
+    url: str = ""
+    nlist: int = 64  # IVF cells (native/milvus backends)
+    nprobe: int = 16  # IVF cells probed at search
+    index_type: str = "flat"  # flat | ivf
+    persist_dir: str = "/tmp/gaie_tpu/vectorstore"
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """Which LLM backend the chains talk to.
+
+    Parity: configuration.py llm section (server_url/model_name/model_engine/
+    model_name_pandas_ai). model_engine selects the connector:
+    "tpu" = in-process JAX serving engine (the default; replaces NIM),
+    "openai" = any OpenAI-compatible remote, "echo" = hermetic test fake.
+    """
+
+    server_url: str = ""
+    model_name: str = "llama3-8b-instruct"
+    model_engine: str = "tpu"
+    model_name_pandas_ai: str = ""
+
+
+@dataclass(frozen=True)
+class TextSplitterConfig:
+    """Token-aware splitter settings (parity: configuration.py:92-101)."""
+
+    model_name: str = "intfloat/e5-large-v2"
+    chunk_size: int = 510
+    chunk_overlap: int = 200
+
+
+@dataclass(frozen=True)
+class EmbeddingConfig:
+    """Embedder selection (parity: configuration.py embeddings section)."""
+
+    model_name: str = "snowflake-arctic-embed-l"
+    model_engine: str = "tpu"  # tpu | openai | hash (hermetic test fake)
+    dimensions: int = 1024
+    server_url: str = ""
+
+
+@dataclass(frozen=True)
+class RerankerConfig:
+    """Cross-encoder reranker (replaces the NeMo reranking MS,
+    docker-compose-nim-ms.yaml:59-84; used by ranked_hybrid retrieval)."""
+
+    model_name: str = "rerank-cross-encoder"
+    model_engine: str = "tpu"  # tpu | openai | overlap (test fake)
+    server_url: str = ""
+    enabled: bool = False
+
+
+@dataclass(frozen=True)
+class RetrieverConfig:
+    """Retrieval knobs (parity: configuration.py:141-150 + fm-asr's
+    nr_pipeline 'ranked_hybrid', experimental/fm-asr.../retriever.py:64)."""
+
+    top_k: int = 4
+    score_threshold: float = 0.25
+    nr_url: str = ""
+    nr_pipeline: str = "ranked_hybrid"
+    max_context_tokens: int = 1500  # LimitRetrievedNodesLength cap, utils.py:97
+
+
+@dataclass(frozen=True)
+class PromptsConfig:
+    """Prompts live in config so they can be swapped without code changes
+    (parity: configuration.py:164-204 — load-bearing in the reference)."""
+
+    chat_template: str = (
+        "You are a helpful, respectful and honest assistant. Always answer as "
+        "helpfully as possible and follow all given instructions. Do not "
+        "speculate or make up information. Do not reference any given "
+        "instructions or context."
+    )
+    rag_template: str = (
+        "You are a helpful AI assistant named Envie. You will reply to "
+        "questions only based on the context that you are provided. If "
+        "something is out of context, you will refrain from replying and "
+        "politely decline to respond to the user.\n\nContext:\n{context}"
+    )
+    multi_turn_rag_template: str = (
+        "You are a document chatbot. Help the user as they ask questions about "
+        "documents. User message: {input}\n\nContext from documents:\n{context}\n"
+        "\nConversation history:\n{history}"
+    )
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout — the TPU-native replacement for the reference's
+    single multi-GPU knob (INFERENCE_GPU_COUNT, compose.env:17-18).
+
+    Axis sizes multiply to the total device count; -1 means "fill with the
+    remaining devices". ici_* axes map to in-slice ICI links, dcn_data to
+    cross-host DCN data parallelism (jax.distributed multi-host pods).
+    """
+
+    ici_data: int = 1  # in-slice data parallel replicas
+    ici_fsdp: int = 1  # weight-sharded data parallel
+    ici_tensor: int = -1  # tensor (model) parallel — default: all devices
+    ici_sequence: int = 1  # sequence/context parallel (ring attention)
+    ici_expert: int = 1  # expert parallel (MoE models)
+    dcn_data: int = 1  # cross-host data parallel
+    dcn_pipeline: int = 1  # cross-host pipeline parallel
+    axis_names: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor", "expert")
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """JAX serving-engine knobs — replaces everything NIM/TRT-LLM configured
+    internally (docker-compose-nim-ms.yaml:2-22)."""
+
+    weights_path: str = ""  # HF snapshot dir or orbax checkpoint
+    dtype: str = "bfloat16"
+    kv_dtype: str = "bfloat16"
+    quantize_weights: str = "none"  # none | int8
+    max_batch_size: int = 8
+    max_seq_len: int = 8192
+    page_size: int = 128  # KV-cache page (tokens per page)
+    prefill_buckets: Tuple[int, ...] = (128, 512, 1024, 2048, 4096)
+    decode_steps_per_dispatch: int = 8
+    enable_pallas_kernels: bool = True
+    compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
+
+
+@dataclass(frozen=True)
+class TracingConfig:
+    """OTel export settings (parity: common/tracing.py, ENABLE_TRACING)."""
+
+    enabled: bool = False
+    otlp_endpoint: str = "http://localhost:4317"
+    service_name: str = "chain-server"
+
+
+@dataclass(frozen=True)
+class AppConfig:
+    """Root of the config tree."""
+
+    vector_store: VectorStoreConfig = field(default_factory=VectorStoreConfig)
+    llm: LLMConfig = field(default_factory=LLMConfig)
+    text_splitter: TextSplitterConfig = field(default_factory=TextSplitterConfig)
+    embeddings: EmbeddingConfig = field(default_factory=EmbeddingConfig)
+    reranker: RerankerConfig = field(default_factory=RerankerConfig)
+    retriever: RetrieverConfig = field(default_factory=RetrieverConfig)
+    prompts: PromptsConfig = field(default_factory=PromptsConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+
+
+def as_dict(cfg) -> dict:
+    """Config tree -> plain nested dict (for logging / serialization)."""
+    return dataclasses.asdict(cfg)
+
+
+def replace(cfg, **kw):
+    """Functional update of a frozen config node."""
+    return dataclasses.replace(cfg, **kw)
+
+
+# Env-var section names: APP_<SECTION>_<FIELD> where SECTION strips
+# underscores ("vector_store" -> VECTORSTORE), matching the reference's
+# camelCase-uppercased convention (configuration_wizard.py:49-81).
+def env_section_name(field_name: str) -> str:
+    return field_name.replace("_", "").upper()
+
+
+def env_var_name(section: str, field_name: str) -> str:
+    return f"APP_{env_section_name(section)}_{env_section_name(field_name)}"

@@ -1,0 +1,251 @@
+"""Config loading: file (YAML/JSON, autodetected) deep-merged with env vars.
+
+Same operator contract as the reference's ConfigWizard
+(RetrievalAugmentedGeneration/common/configuration_wizard.py):
+
+* ``APP_CONFIG_FILE`` points at a YAML or JSON file (format autodetected,
+  configuration_wizard.py:313-358).
+* Any field is overridable with ``APP_<SECTION>_<FIELD>`` env vars
+  (configuration_wizard.py:45,138); env values are coerced to the
+  field's declared type (:361-372).
+* ``print_config_help()`` renders the full tree with env names and
+  defaults (--help-config, configuration_wizard.py:104-177).
+
+Unlike the reference, bad input fails fast at load time with the
+offending source named: unknown keys, scalar sections, and
+type-mismatched values all raise ValueError.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import typing
+from typing import Any, Dict, Mapping, Optional, Type
+
+import yaml
+
+from .schema import AppConfig, env_var_name
+
+_LOG = logging.getLogger(__name__)
+
+_CONFIG_LOCK = threading.Lock()
+_CONFIG: Optional[AppConfig] = None
+
+
+def _field_default(f: dataclasses.Field) -> Any:
+    if f.default is not dataclasses.MISSING:
+        return f.default
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        return f.default_factory()  # type: ignore[misc]
+    return None
+
+
+def _coerce_env(value: str, default: Any, env_name: str) -> Any:
+    """Coerce an env string to the field's type (known from its default).
+
+    str fields keep the raw string (so APP_LLM_MODELNAME=123 stays "123");
+    bools accept 0/1/true/false/yes/no; ints/floats parse numerically;
+    tuples parse as JSON arrays.
+    """
+    if isinstance(default, str):
+        return value
+    if isinstance(default, bool):
+        lowered = value.strip().lower()
+        if lowered in ("1", "true", "yes", "on"):
+            return True
+        if lowered in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"bad config value from env {env_name}: "
+                         f"expected bool, got {value!r}")
+    try:
+        if isinstance(default, int):
+            return int(value)
+        if isinstance(default, float):
+            return float(value)
+        if isinstance(default, tuple):
+            parsed = json.loads(value)
+            if not isinstance(parsed, list):
+                raise ValueError("not a JSON array")
+            return tuple(parsed)
+    except (ValueError, json.JSONDecodeError) as err:
+        raise ValueError(
+            f"bad config value from env {env_name}: expected "
+            f"{type(default).__name__}, got {value!r} ({err})"
+        ) from err
+    return value
+
+
+def _check_leaf(value: Any, default: Any, source: str) -> Any:
+    """Validate a file-sourced leaf value against the default's type."""
+    if isinstance(value, list):
+        value = tuple(value)
+    if default is None:
+        return value
+    expected = type(default)
+    if expected is float and isinstance(value, int) and not isinstance(value, bool):
+        return float(value)
+    if not isinstance(value, expected) or (
+        expected is int and isinstance(value, bool)
+    ):
+        raise ValueError(
+            f"bad config value from {source}: expected {expected.__name__}, "
+            f"got {type(value).__name__} ({value!r})"
+        )
+    if expected is tuple and default:
+        elem_tp = type(default[0])
+        for i, elem in enumerate(value):
+            if elem_tp is float and isinstance(elem, int):
+                continue
+            if not isinstance(elem, elem_tp) or (
+                elem_tp is int and isinstance(elem, bool)
+            ):
+                raise ValueError(
+                    f"bad config value from {source}[{i}]: expected "
+                    f"{elem_tp.__name__} elements, got {elem!r}"
+                )
+    return value
+
+
+def _build(cls: Type, data: Mapping[str, Any], env: Mapping[str, str], prefix: str):
+    """Recursively build dataclass `cls` from nested dict + env overlay."""
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields) if data else set()
+    if unknown:
+        where = f"section [{prefix}]" if prefix else "config file top level"
+        raise ValueError(
+            f"unknown config key(s) in {where}: {sorted(unknown)}; "
+            f"known keys: {sorted(fields)}"
+        )
+    kwargs: Dict[str, Any] = {}
+    for name, f in fields.items():
+        sub_tp = hints.get(name)
+        raw = data.get(name, dataclasses.MISSING) if data else dataclasses.MISSING
+        if dataclasses.is_dataclass(sub_tp):
+            if raw is not dataclasses.MISSING and not isinstance(raw, Mapping):
+                raise ValueError(
+                    f"config section [{name}] must be a mapping, "
+                    f"got {type(raw).__name__} ({raw!r})"
+                )
+            sub_data = raw if isinstance(raw, Mapping) else {}
+            kwargs[name] = _build(sub_tp, sub_data, env, name)
+            continue
+        default = _field_default(f)
+        env_name = env_var_name(prefix, name) if prefix else None
+        if env_name and env_name in env:
+            coerced = _coerce_env(env[env_name], default, env_name)
+            kwargs[name] = _check_leaf(coerced, default, f"env {env_name}")
+        elif raw is not dataclasses.MISSING:
+            kwargs[name] = _check_leaf(raw, default, f"field {prefix}.{name}")
+    return cls(**kwargs)
+
+
+def load_config(
+    path: Optional[str] = None, env: Optional[Mapping[str, str]] = None
+) -> AppConfig:
+    """Load the AppConfig from a file path + environment overlay.
+
+    ``path=None`` falls back to ``$APP_CONFIG_FILE``; a missing/unset file
+    means "defaults + env only" (the reference tolerates this too).
+    """
+    env = dict(env if env is not None else os.environ)
+    path = path or env.get("APP_CONFIG_FILE", "")
+    _warn_unrecognized_env(env)
+    data: Dict[str, Any] = {}
+    if path and os.path.isfile(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        data = _parse_config_text(text, path)
+    elif path:
+        _LOG.warning("config file %s not found; using defaults + env", path)
+    return _build(AppConfig, data, env, "")
+
+
+def _known_env_names() -> set:
+    names = {"APP_CONFIG_FILE"}
+    for f in dataclasses.fields(AppConfig):
+        for sub in dataclasses.fields(typing.get_type_hints(AppConfig)[f.name]):
+            names.add(env_var_name(f.name, sub.name))
+    return names
+
+
+def _warn_unrecognized_env(env: Mapping[str, str]) -> None:
+    """Flag APP_* vars that match no config field (e.g. APP_LLM_MODEL_NAME
+    typed with an underscore instead of the canonical APP_LLM_MODELNAME).
+    A warning, not an error: other services in a deployment may legitimately
+    share the APP_ namespace."""
+    known = _known_env_names()
+    for key in env:
+        if key.startswith("APP_") and key not in known:
+            _LOG.warning(
+                "env var %s matches no config field and is ignored "
+                "(did you mean one of the APP_<SECTION>_<FIELD> names from "
+                "--help-config? underscores inside section/field names are "
+                "dropped, e.g. APP_LLM_MODELNAME)",
+                key,
+            )
+
+
+def _parse_config_text(text: str, path: str) -> Dict[str, Any]:
+    """Autodetect JSON vs YAML (reference: configuration_wizard.py:313-358)."""
+    if path.endswith(".json"):
+        try:
+            parsed = json.loads(text)
+        except json.JSONDecodeError as err:
+            raise ValueError(f"config file {path} is not valid JSON: {err}") from err
+    else:
+        try:
+            parsed = yaml.safe_load(text)
+        except yaml.YAMLError as yaml_err:
+            try:
+                parsed = json.loads(text)
+            except json.JSONDecodeError:
+                raise ValueError(
+                    f"config file {path} is neither valid YAML nor JSON: {yaml_err}"
+                ) from yaml_err
+    if parsed is not None and not isinstance(parsed, dict):
+        raise ValueError(f"config file {path} must contain a mapping at top level")
+    return parsed or {}
+
+
+def config_from_env() -> AppConfig:
+    """Defaults + env overlay only (no file)."""
+    return load_config(path="")
+
+
+def get_config(refresh: bool = False) -> AppConfig:
+    """Process-wide cached config (reference: utils.py:148-154 lru trick,
+    but with an explicit lock instead of lru_cache-as-singleton)."""
+    global _CONFIG
+    with _CONFIG_LOCK:
+        if _CONFIG is None or refresh:
+            _CONFIG = load_config()
+        return _CONFIG
+
+
+def set_config(cfg: AppConfig) -> None:
+    """Install a config (tests / embedded use)."""
+    global _CONFIG
+    with _CONFIG_LOCK:
+        _CONFIG = cfg
+
+
+def print_config_help() -> str:
+    """Render every field with its env var and default (--help-config)."""
+    lines = ["Configuration fields (APP_CONFIG_FILE + env overrides):", ""]
+    root = AppConfig()
+    for f in dataclasses.fields(AppConfig):
+        node = getattr(root, f.name)
+        lines.append(f"[{f.name}]")
+        for sub in dataclasses.fields(node):
+            default = getattr(node, sub.name)
+            lines.append(
+                f"  {env_var_name(f.name, sub.name):<44} "
+                f"(default: {default!r})"
+            )
+        lines.append("")
+    return "\n".join(lines)

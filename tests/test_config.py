@@ -1,0 +1,142 @@
+"""Config system tests: file load, env overlay, type coercion, help."""
+
+import json
+
+from generativeaiexamples_tpu.config import AppConfig, load_config
+from generativeaiexamples_tpu.config.schema import env_var_name
+from generativeaiexamples_tpu.config.wizard import print_config_help
+
+
+def test_defaults():
+    cfg = AppConfig()
+    assert cfg.retriever.top_k == 4
+    assert cfg.retriever.score_threshold == 0.25
+    assert cfg.text_splitter.chunk_size == 510
+    assert cfg.text_splitter.chunk_overlap == 200
+    assert cfg.vector_store.nlist == 64 and cfg.vector_store.nprobe == 16
+    assert cfg.retriever.max_context_tokens == 1500
+    assert cfg.llm.model_engine == "tpu"
+
+
+def test_env_var_names():
+    assert env_var_name("vector_store", "url") == "APP_VECTORSTORE_URL"
+    assert env_var_name("llm", "model_name") == "APP_LLM_MODELNAME"
+    assert env_var_name("text_splitter", "chunk_size") == "APP_TEXTSPLITTER_CHUNKSIZE"
+
+
+def test_yaml_file_load(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        "llm:\n  model_name: my-model\nretriever:\n  top_k: 9\n"
+        "mesh:\n  ici_tensor: 4\n  ici_data: 2\n"
+    )
+    cfg = load_config(str(p), env={})
+    assert cfg.llm.model_name == "my-model"
+    assert cfg.retriever.top_k == 9
+    assert cfg.mesh.ici_tensor == 4 and cfg.mesh.ici_data == 2
+    # untouched sections keep defaults
+    assert cfg.embeddings.dimensions == 1024
+
+
+def test_json_file_load(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({"vector_store": {"name": "tpu", "nprobe": 32}}))
+    cfg = load_config(str(p), env={})
+    assert cfg.vector_store.name == "tpu"
+    assert cfg.vector_store.nprobe == 32
+
+
+def test_env_overlay_beats_file(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("retriever:\n  top_k: 9\n")
+    cfg = load_config(str(p), env={"APP_RETRIEVER_TOPK": "17"})
+    assert cfg.retriever.top_k == 17  # env wins, JSON-coerced to int
+
+
+def test_env_coercion_types():
+    env = {
+        "APP_RETRIEVER_SCORETHRESHOLD": "0.5",
+        "APP_TRACING_ENABLED": "true",
+        "APP_LLM_MODELNAME": "plain-string",
+        "APP_ENGINE_PREFILLBUCKETS": "[256, 512]",
+    }
+    cfg = load_config(path="", env=env)
+    assert cfg.retriever.score_threshold == 0.5
+    assert cfg.tracing.enabled is True
+    assert cfg.llm.model_name == "plain-string"
+    assert cfg.engine.prefill_buckets == (256, 512)
+
+
+def test_env_bool_accepts_01():
+    cfg = load_config(path="", env={"APP_TRACING_ENABLED": "1"})
+    assert cfg.tracing.enabled is True
+    cfg = load_config(path="", env={"APP_RERANKER_ENABLED": "0"})
+    assert cfg.reranker.enabled is False
+
+
+def test_env_str_field_keeps_numeric_string():
+    cfg = load_config(path="", env={"APP_LLM_MODELNAME": "123"})
+    assert cfg.llm.model_name == "123"
+
+
+def test_bad_env_type_raises():
+    import pytest
+
+    with pytest.raises(ValueError, match="APP_RETRIEVER_TOPK"):
+        load_config(path="", env={"APP_RETRIEVER_TOPK": '{"weird": 1}'})
+
+
+def test_unknown_key_raises(tmp_path):
+    import pytest
+
+    p = tmp_path / "c.yaml"
+    p.write_text("retreiver:\n  top_k: 9\n")  # typo'd section
+    with pytest.raises(ValueError, match="retreiver"):
+        load_config(str(p), env={})
+    p.write_text("retriever:\n  topk: 9\n")  # typo'd field
+    with pytest.raises(ValueError, match="topk"):
+        load_config(str(p), env={})
+
+
+def test_scalar_section_raises(tmp_path):
+    import pytest
+
+    p = tmp_path / "c.yaml"
+    p.write_text("llm: my-model\n")
+    with pytest.raises(ValueError, match=r"section \[llm\]"):
+        load_config(str(p), env={})
+
+
+def test_json_array_toplevel_raises(tmp_path):
+    import pytest
+
+    p = tmp_path / "c.json"
+    p.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="mapping at top level"):
+        load_config(str(p), env={})
+
+
+def test_tuple_element_types_checked():
+    import pytest
+
+    with pytest.raises(ValueError, match="PREFILLBUCKETS"):
+        load_config(path="", env={"APP_ENGINE_PREFILLBUCKETS": '["128", "512"]'})
+
+
+def test_missing_file_falls_back(tmp_path):
+    cfg = load_config(str(tmp_path / "nope.yaml"), env={})
+    assert cfg == AppConfig()
+
+
+def test_config_file_via_env(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("llm:\n  server_url: http://somewhere:8000\n")
+    cfg = load_config(env={"APP_CONFIG_FILE": str(p)})
+    assert cfg.llm.server_url == "http://somewhere:8000"
+
+
+def test_help_mentions_every_env_var():
+    text = print_config_help()
+    assert "APP_VECTORSTORE_URL" in text
+    assert "APP_MESH_ICITENSOR" in text
+    assert "APP_ENGINE_PAGESIZE" in text
