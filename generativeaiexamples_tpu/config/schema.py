@@ -136,7 +136,8 @@ class MeshConfig:
     ici_expert: int = 1  # expert parallel (MoE models)
     dcn_data: int = 1  # cross-host data parallel
     dcn_pipeline: int = 1  # cross-host pipeline parallel
-    axis_names: Tuple[str, ...] = ("data", "fsdp", "sequence", "tensor", "expert")
+    # Axis names are fixed by parallel.mesh.MESH_AXIS_NAMES (pipeline, data,
+    # fsdp, expert, sequence, tensor) — not configurable.
 
 
 @dataclass(frozen=True)

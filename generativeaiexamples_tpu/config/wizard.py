@@ -153,7 +153,8 @@ def load_config(
     means "defaults + env only" (the reference tolerates this too).
     """
     env = dict(env if env is not None else os.environ)
-    path = path or env.get("APP_CONFIG_FILE", "")
+    if path is None:
+        path = env.get("APP_CONFIG_FILE", "")
     _warn_unrecognized_env(env)
     data: Dict[str, Any] = {}
     if path and os.path.isfile(path):
@@ -213,7 +214,7 @@ def _parse_config_text(text: str, path: str) -> Dict[str, Any]:
 
 
 def config_from_env() -> AppConfig:
-    """Defaults + env overlay only (no file)."""
+    """Defaults + env overlay only — never reads APP_CONFIG_FILE."""
     return load_config(path="")
 
 

@@ -1,0 +1,268 @@
+"""Attention ops: XLA reference impls + Pallas TPU flash-attention.
+
+This is the compute core the reference outsources to TensorRT-LLM inside
+NIM containers (SURVEY.md §2.3). Design:
+
+- `mha_reference`: pure-jnp scaled-dot-product attention with GQA,
+  causal + padding masks. Runs on any backend; the numerics oracle for
+  the kernels and the CPU-test fallback.
+- `flash_attention`: Pallas TPU kernel, online-softmax tiling so the
+  S×S score matrix never materializes in HBM. Grid iterates k-blocks
+  innermost (TPU grids execute sequentially, so VMEM scratch carries the
+  running max/denominator across k-steps). GQA handled by index-mapping
+  q-head -> kv-head, so KV is never repeated in memory.
+- `attention`: dispatcher — Pallas on TPU, reference elsewhere.
+
+All shapes are [batch, heads, seq, head_dim]; `lengths` is [batch] valid
+token counts (padding mask), `causal` toggles the autoregressive mask.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU-capable installs; tests interpret on CPU
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _gqa_expand(k: jax.Array, n_q_heads: int) -> jax.Array:
+    """[B, KH, S, D] -> [B, H, S, D] by repeating each kv head."""
+    n_kv = k.shape[1]
+    if n_kv == n_q_heads:
+        return k
+    assert n_q_heads % n_kv == 0, (n_q_heads, n_kv)
+    return jnp.repeat(k, n_q_heads // n_kv, axis=1)
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    lengths: Optional[jax.Array] = None,
+    q_offset: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Scaled-dot-product attention, GQA-aware, fp32 softmax.
+
+    q: [B, H, Sq, D]; k/v: [B, KH, Sk, D]; lengths: [B] valid kv length;
+    q_offset: [B] absolute position of q[0] (for decode: Sq=1, offset=pos).
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    scale = scale if scale is not None else D ** -0.5
+    k = _gqa_expand(k, H)
+    v = _gqa_expand(v, H)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    kv_pos = jnp.arange(Sk)[None, None, None, :]
+    mask = jnp.ones((B, 1, Sq, Sk), dtype=bool)
+    if lengths is not None:
+        mask &= kv_pos < lengths[:, None, None, None]
+    if causal:
+        off = q_offset if q_offset is not None else jnp.zeros((B,), jnp.int32)
+        q_pos = jnp.arange(Sq)[None, None, :, None] + off[:, None, None, None]
+        mask &= kv_pos <= q_pos
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (prefill)
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    lengths_ref,  # scalar-prefetch: [B] int32
+    q_ref,  # [1, 1, bq, D]
+    k_ref,  # [1, 1, bk, D]
+    v_ref,  # [1, 1, bk, D]
+    o_ref,  # [1, 1, bq, D]
+    m_ref,  # scratch [bq, 128] f32 (running max, lane-broadcast)
+    l_ref,  # scratch [bq, 128] f32 (running denom)
+    acc_ref,  # scratch [bq, D] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = kv_pos < lengths_ref[b]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid &= kv_pos <= q_pos
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # rescale of old state
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        p = jnp.where(valid, p, 0.0)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # Skip k-blocks strictly above the causal diagonal.
+        pl.when(k_start <= q_start + block_q - 1)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        denom = l_ref[:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)  # fully-masked rows
+        o_ref[0, 0, ...] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    lengths: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas TPU flash attention. q [B,H,S,D], k/v [B,KH,S,D].
+
+    Sequence length must be a multiple of the block sizes after clamping
+    (callers pad to bucket sizes; serving always runs bucketed shapes so
+    XLA never re-tiles — SURVEY.md §7.4 item 2).
+    """
+    if pltpu is None:
+        raise RuntimeError(
+            "Pallas TPU support unavailable in this jax install; "
+            "use mha_reference / attention() instead"
+        )
+    B, H, S, D = q.shape
+    KH = k.shape[1]
+    group = H // KH
+    scale = scale if scale is not None else D ** -0.5
+    # Shrink blocks to the largest power-of-two divisor of S (callers run
+    # bucketed shapes, so S is always a multiple of 128 in serving).
+    while S % block_q:
+        block_q //= 2
+    while S % block_k:
+        block_k //= 2
+    assert block_q >= 8 and block_k >= 8, (S, block_q, block_k)
+    nq, nk = S // block_q, S // block_k
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)
+
+    grid = (B, H, nq, nk)
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=nk,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki, L: (b, h, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, qi, ki, L: (b, h // group, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, D), lambda b, h, qi, ki, L: (b, h // group, ki, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, qi, ki, L: (b, h, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k, v)
+
+
+def decode_attention_reference(
+    q: jax.Array,  # [B, H, D] — one new token per sequence
+    k_cache: jax.Array,  # [B, KH, S_max, D]
+    v_cache: jax.Array,
+    lengths: jax.Array,  # [B] tokens already in cache INCLUDING current
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-step decode attention against a contiguous KV cache."""
+    out = mha_reference(
+        q[:, :, None, :],
+        k_cache,
+        v_cache,
+        causal=False,
+        lengths=lengths,
+        scale=scale,
+    )
+    return out[:, :, 0, :]
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def attention(
+    q, k, v, *, causal=True, lengths=None, q_offset=None, scale=None,
+    use_pallas: Optional[bool] = None,
+):
+    """Dispatch: Pallas flash kernel on TPU, XLA reference elsewhere."""
+    use_pallas = on_tpu() if use_pallas is None else use_pallas
+    S = q.shape[2]
+    if use_pallas and pltpu is not None and q_offset is None and S % 128 == 0:
+        return flash_attention(q, k, v, causal=causal, lengths=lengths, scale=scale)
+    return mha_reference(
+        q, k, v, causal=causal, lengths=lengths, q_offset=q_offset, scale=scale
+    )

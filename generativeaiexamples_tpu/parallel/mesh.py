@@ -1,0 +1,179 @@
+"""Device-mesh construction: the framework's parallelism substrate.
+
+The reference's entire multi-device story is one env var handed to an
+external engine (INFERENCE_GPU_COUNT, deploy/compose/compose.env:17-18 —
+NCCL tensor parallelism hidden inside TRT-LLM/NIM). Here parallelism is
+owned in-repo and TPU-native: a `jax.sharding.Mesh` over ICI (in-slice)
+and DCN (cross-host) axes, with XLA emitting the collectives.
+
+Axes (logical meaning, fastest-varying last so TP rides ICI):
+
+    dcn_pipeline > dcn_data   — cross-host (slow links)
+    data > fsdp > expert > sequence > tensor — in-slice (ICI)
+
+`MeshConfig` axis sizes multiply to the device count; one axis may be -1
+("fill with whatever devices remain"), mirroring the ergonomics of
+jax.numpy reshape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from generativeaiexamples_tpu.config.schema import MeshConfig
+
+# Canonical axis order: DCN (slowest) first, tensor (fastest / most
+# bandwidth-hungry) last so that tensor-parallel collectives map onto
+# nearest-neighbour ICI links.
+MESH_AXIS_NAMES = ("pipeline", "data", "fsdp", "expert", "sequence", "tensor")
+
+
+def _resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> dict:
+    if cfg.ici_data == -1 and cfg.dcn_data == -1:
+        raise ValueError("only one of ici_data/dcn_data may be -1")
+    if cfg.ici_data == -1 or cfg.dcn_data == -1:
+        # The "data" mesh axis is the ici*dcn product; a wildcard in either
+        # factor makes the combined axis the wildcard (the fixed factor is
+        # folded back in by the divisibility check below).
+        data = -1 if (cfg.ici_data * cfg.dcn_data) < 0 else cfg.ici_data * cfg.dcn_data
+    else:
+        data = cfg.ici_data * cfg.dcn_data
+    sizes = {
+        "pipeline": cfg.dcn_pipeline,
+        "data": data,
+        "fsdp": cfg.ici_fsdp,
+        "expert": cfg.ici_expert,
+        "sequence": cfg.ici_sequence,
+        "tensor": cfg.ici_tensor,
+    }
+    wildcards = [k for k, v in sizes.items() if v == -1]
+    if any(v < 1 and v != -1 for v in sizes.values()):
+        raise ValueError(f"mesh axis sizes must be >= 1 or -1, got {sizes}")
+    if len(wildcards) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {wildcards}")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if wildcards:
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wildcards[0]] = n_devices // fixed
+    elif fixed != n_devices:
+        raise ValueError(
+            f"mesh axes product {fixed} != device count {n_devices}; "
+            f"set one axis to -1 to auto-fill"
+        )
+    return sizes
+
+
+def build_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the global device mesh from config.
+
+    Works identically on real TPU slices and on the CPU test backend with
+    --xla_force_host_platform_device_count=N emulated devices.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = _resolve_axis_sizes(cfg, len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXIS_NAMES)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXIS_NAMES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """Trivial 1-device mesh (all axes size 1) — lets every model fn run
+    unmodified on one chip or one CPU device."""
+    device = device or jax.devices()[0]
+    shape = (1,) * len(MESH_AXIS_NAMES)
+    return Mesh(np.asarray([device]).reshape(shape), MESH_AXIS_NAMES)
+
+
+def mesh_axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.shape else 1
+
+
+# ---------------------------------------------------------------------------
+# Logical sharding rules
+# ---------------------------------------------------------------------------
+# Model code annotates arrays with *logical* axis names; the rule table maps
+# them to mesh axes. Swapping a parallelism layout = swapping the rule table,
+# no model changes (the flax "logical partitioning" idiom, done by hand so the
+# models stay pure-JAX pytrees).
+
+# Default rules for decoder LLMs (llama family):
+#   - embed/activation hidden dim replicated across tensor, sharded for fsdp
+#   - attention heads + mlp intermediate sharded on tensor (Megatron layout)
+#   - vocab sharded on tensor for the big embed/unembed matmuls
+LLM_RULES: dict = {
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "embed": None,
+    "embed_fsdp": "fsdp",  # weight hidden-dim axis: FSDP shards here
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "mlp": "tensor",
+    "vocab": "tensor",
+    "expert": "expert",
+    "layers": None,  # stacked-layer leading axis (scanned) — never sharded
+    "kv_pages": None,
+}
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]], rules: dict = LLM_RULES) -> PartitionSpec:
+    """("batch","seq","embed") -> PartitionSpec(("data","fsdp"),"sequence",None)."""
+    out = []
+    for ax in logical_axes:
+        if ax is None:
+            out.append(None)
+        else:
+            if ax not in rules:
+                raise KeyError(f"unknown logical axis {ax!r}")
+            out.append(rules[ax])
+    return PartitionSpec(*out)
+
+
+def named_sharding(mesh: Mesh, *logical_axes, rules: dict = LLM_RULES) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def spec_tree_to_shardings(mesh: Mesh, spec_tree):
+    """Map a pytree of PartitionSpec -> pytree of NamedSharding."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def shard_pytree(tree, spec_tree, mesh: Mesh):
+    """Place a host pytree onto the mesh with the given PartitionSpecs."""
+    shardings = spec_tree_to_shardings(mesh, spec_tree)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def maybe_initialize_distributed() -> None:
+    """Multi-host init (DCN): no-op unless JAX_COORDINATOR_ADDRESS is set;
+    on pods this wires jax.distributed so device lists span hosts
+    (reference analog: none — NIM hides it; SURVEY.md §5.8). Failures
+    propagate: a silently-uncoordinated host would compute wrong
+    collectives, which is strictly worse than crashing at startup."""
+    import os
+
+    if jax.process_count() == 1 and os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()

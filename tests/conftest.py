@@ -8,13 +8,23 @@ paths compile and execute real collectives.
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The test suite always runs on the emulated 8-device CPU backend (TPU
+# smoke tests are run explicitly via bench.py / scripts, not pytest).
+# The axon TPU tunnel's sitecustomize force-selects its backend via
+# jax.config at interpreter start, so env vars alone are too late —
+# override through jax.config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import re
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,103 @@
+"""Attention kernel numerics: Pallas (interpret mode) vs XLA reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.ops import attention as attn
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype=dtype)
+
+
+def _naive(q, k, v, causal, lengths=None):
+    """Straightforward softmax attention for cross-checking the reference."""
+    B, H, S, D = q.shape
+    k = attn._gqa_expand(k, H)
+    v = attn._gqa_expand(v, H)
+    out = np.zeros(q.shape, np.float32)
+    q, k, v = map(lambda a: np.asarray(a, np.float64), (q, k, v))
+    for b in range(B):
+        L = int(lengths[b]) if lengths is not None else S
+        for h in range(H):
+            s = q[b, h] @ k[b, h].T / np.sqrt(D)
+            mask = np.zeros((S, S), bool)
+            mask[:, :L] = True
+            if causal:
+                mask &= np.tril(np.ones((S, S), bool))
+            s = np.where(mask, s, -np.inf)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = np.where(mask, p, 0)
+            p /= np.maximum(p.sum(-1, keepdims=True), 1e-30)
+            out[b, h] = p @ v[b, h]
+    return out
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("kv_heads", [4, 2, 1])
+def test_mha_reference_matches_naive(causal, kv_heads):
+    B, H, S, D = 2, 4, 32, 16
+    q = _rand((B, H, S, D), 0)
+    k = _rand((B, kv_heads, S, D), 1)
+    v = _rand((B, kv_heads, S, D), 2)
+    lengths = jnp.array([32, 17])
+    got = attn.mha_reference(q, k, v, causal=causal, lengths=lengths)
+    want = _naive(q, k, v, causal, lengths=np.array([32, 17]))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_interpret_matches_reference(causal):
+    B, H, KH, S, D = 2, 4, 2, 128, 32
+    q = _rand((B, H, S, D), 3)
+    k = _rand((B, KH, S, D), 4)
+    v = _rand((B, KH, S, D), 5)
+    lengths = jnp.array([128, 70])
+    got = attn.flash_attention(
+        q, k, v, causal=causal, lengths=lengths,
+        block_q=32, block_k=32, interpret=True,
+    )
+    want = attn.mha_reference(q, k, v, causal=causal, lengths=lengths)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_decode_attention_matches_prefill_last_row():
+    """Decoding token t must equal row t of a causal prefill."""
+    B, H, KH, S, D = 2, 4, 2, 24, 16
+    q = _rand((B, H, S, D), 6)
+    k = _rand((B, KH, S, D), 7)
+    v = _rand((B, KH, S, D), 8)
+    full = attn.mha_reference(q, k, v, causal=True)
+    t = 10
+    out = attn.decode_attention_reference(
+        q[:, :, t, :], k, v, lengths=jnp.full((B,), t + 1)
+    )
+    np.testing.assert_allclose(out, full[:, :, t, :], atol=2e-5)
+
+
+def test_mips_topk_exact():
+    from generativeaiexamples_tpu.ops.topk import mips_topk
+
+    rng = np.random.default_rng(0)
+    db = rng.normal(size=(256, 64)).astype(np.float32)
+    q = rng.normal(size=(5, 64)).astype(np.float32)
+    scores, idx = mips_topk(q, db, 7)
+    want = (q @ db.T).argsort(axis=1)[:, ::-1][:, :7]
+    np.testing.assert_array_equal(np.asarray(idx), want)
+
+
+def test_sharded_mips_topk_matches_single(eight_devices):
+    from generativeaiexamples_tpu.config.schema import MeshConfig
+    from generativeaiexamples_tpu.ops.topk import mips_topk, sharded_mips_topk
+    from generativeaiexamples_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(MeshConfig())
+    rng = np.random.default_rng(1)
+    db = rng.normal(size=(512, 32)).astype(np.float32)
+    q = rng.normal(size=(3, 32)).astype(np.float32)
+    s1, i1 = mips_topk(q, db, 5)
+    s2, i2 = sharded_mips_topk(q, db, 5, mesh)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
