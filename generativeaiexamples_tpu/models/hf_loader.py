@@ -1,0 +1,208 @@
+"""HF checkpoint -> pytree weight loading.
+
+The reference never loads weights (NIM containers pull them from NGC,
+deploy/compose/docker-compose-nim-ms.yaml:86-160 download jobs). Here
+weights come straight from HF-format snapshots (safetensors) into the
+stacked-layer pytrees of models.llama / models.bert, optionally sharded
+onto a mesh during load (per-leaf device_put with the model's
+PartitionSpec so no host ever materializes more than one full tensor).
+
+Name mappings are explicit tables — no torch import needed for loading
+(safetensors reads straight to numpy); torch only appears in tests that
+build golden models.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.models import bert as bert_lib
+from generativeaiexamples_tpu.models import llama as llama_lib
+
+
+def _stack(sd: Mapping[str, np.ndarray], fmt: str, n_layers: int,
+           transpose: bool = False) -> np.ndarray:
+    mats = [np.asarray(sd[fmt.format(i)]) for i in range(n_layers)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return np.stack(mats)
+
+
+def _llama_numpy_tree(
+    sd: Mapping[str, np.ndarray], cfg: llama_lib.LlamaConfig
+) -> Dict[str, Any]:
+    """HF LlamaForCausalLM names -> models.llama pytree (numpy leaves).
+
+    HF linear weights are [out, in]; ours are [in, out] (x @ w), hence the
+    transposes. HF's q/k rotary convention (rotate_half) matches
+    models.llama.rope, so no permutation is needed.
+    """
+    L = cfg.n_layers
+    p = "model.layers.{}."
+    params: Dict[str, Any] = {
+        "tok_emb": np.asarray(sd["model.embed_tokens.weight"]),
+        "ln_f": np.asarray(sd["model.norm.weight"]),
+        "layers": {
+            "ln1": _stack(sd, p + "input_layernorm.weight", L),
+            "ln2": _stack(sd, p + "post_attention_layernorm.weight", L),
+            "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, p + "self_attn.o_proj.weight", L, transpose=True),
+            "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
+            "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = np.asarray(sd["lm_head.weight"]).T
+    return params
+
+
+def llama_params_from_state_dict(
+    sd: Mapping[str, np.ndarray], cfg: llama_lib.LlamaConfig, dtype=None
+) -> Dict[str, Any]:
+    """HF LlamaForCausalLM state dict -> jnp pytree on the default device
+    (single-chip / test path; use load_llama(mesh=...) for sharded load)."""
+    dtype = dtype or cfg.dtype
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype),
+                        _llama_numpy_tree(sd, cfg))
+
+
+def bert_params_from_state_dict(
+    sd: Mapping[str, np.ndarray], cfg: bert_lib.BertConfig, dtype=None
+) -> Dict[str, Any]:
+    """HF BertModel names -> models.bert pytree. Accepts both bare
+    ("embeddings...") and prefixed ("bert.embeddings...") name styles."""
+    dtype = dtype or cfg.dtype
+    if not any(k.startswith("embeddings.") for k in sd):
+        sd = {re.sub(r"^bert\.", "", k): v for k, v in sd.items()}
+    L = cfg.n_layers
+    p = "encoder.layer.{}."
+    params: Dict[str, Any] = {
+        "tok_emb": np.asarray(sd["embeddings.word_embeddings.weight"]),
+        "pos_emb": np.asarray(sd["embeddings.position_embeddings.weight"]),
+        "type_emb": np.asarray(sd["embeddings.token_type_embeddings.weight"]),
+        "emb_ln": {
+            "w": np.asarray(sd["embeddings.LayerNorm.weight"]),
+            "b": np.asarray(sd["embeddings.LayerNorm.bias"]),
+        },
+        "layers": {
+            "wq": _stack(sd, p + "attention.self.query.weight", L, transpose=True),
+            "bq": _stack(sd, p + "attention.self.query.bias", L),
+            "wk": _stack(sd, p + "attention.self.key.weight", L, transpose=True),
+            "bk": _stack(sd, p + "attention.self.key.bias", L),
+            "wv": _stack(sd, p + "attention.self.value.weight", L, transpose=True),
+            "bv": _stack(sd, p + "attention.self.value.bias", L),
+            "wo": _stack(sd, p + "attention.output.dense.weight", L, transpose=True),
+            "bo": _stack(sd, p + "attention.output.dense.bias", L),
+            "ln1_w": _stack(sd, p + "attention.output.LayerNorm.weight", L),
+            "ln1_b": _stack(sd, p + "attention.output.LayerNorm.bias", L),
+            "w_in": _stack(sd, p + "intermediate.dense.weight", L, transpose=True),
+            "b_in": _stack(sd, p + "intermediate.dense.bias", L),
+            "w_out": _stack(sd, p + "output.dense.weight", L, transpose=True),
+            "b_out": _stack(sd, p + "output.dense.bias", L),
+            "ln2_w": _stack(sd, p + "output.LayerNorm.weight", L),
+            "ln2_b": _stack(sd, p + "output.LayerNorm.bias", L),
+        },
+    }
+    if cfg.n_labels and "classifier.weight" not in sd:
+        raise ValueError(
+            f"config requests n_labels={cfg.n_labels} (cross-encoder head) "
+            "but checkpoint has no classifier.weight — this is an embedding "
+            "checkpoint, not a reranker"
+        )
+    if cfg.n_labels:
+        params["classifier"] = {
+            "pool_w": np.asarray(sd["pooler.dense.weight"]).T
+            if "pooler.dense.weight" in sd else np.eye(cfg.dim, dtype=np.float32),
+            "pool_b": np.asarray(sd.get("pooler.dense.bias", np.zeros(cfg.dim))),
+            "w": np.asarray(sd["classifier.weight"]).T,
+            "b": np.asarray(sd["classifier.bias"]),
+        }
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+
+# ---------------------------------------------------------------------------
+# Safetensors snapshot reading
+# ---------------------------------------------------------------------------
+
+
+def read_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
+    """Read all *.safetensors in an HF snapshot dir into one name->array
+    dict (numpy, zero-copy views where possible)."""
+    from safetensors import safe_open
+
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    out: Dict[str, np.ndarray] = {}
+    for f in files:
+        with safe_open(f, framework="numpy") as fh:
+            for name in fh.keys():
+                out[name] = fh.get_tensor(name)
+    return out
+
+
+def llama_config_from_hf(path: str) -> llama_lib.LlamaConfig:
+    """Derive LlamaConfig from an HF snapshot's config.json."""
+    with open(os.path.join(path, "config.json")) as fh:
+        c = json.load(fh)
+    return llama_lib.LlamaConfig(
+        vocab_size=c["vocab_size"],
+        dim=c["hidden_size"],
+        n_layers=c["num_hidden_layers"],
+        n_heads=c["num_attention_heads"],
+        n_kv_heads=c.get("num_key_value_heads", c["num_attention_heads"]),
+        head_dim=c.get("head_dim", c["hidden_size"] // c["num_attention_heads"]),
+        mlp_dim=c["intermediate_size"],
+        rope_theta=c.get("rope_theta", 10000.0),
+        rms_eps=c.get("rms_norm_eps", 1e-5),
+        max_seq_len=c.get("max_position_embeddings", 8192),
+        tie_embeddings=c.get("tie_word_embeddings", False),
+    )
+
+
+def shard_numpy_tree(tree, spec_tree, mesh, dtype):
+    """Per-leaf host->mesh transfer: each numpy leaf goes straight to its
+    PartitionSpec placement, so no single device ever holds a full tensor
+    (host arrays stay mmap-backed via safetensors). bf16 conversion uses
+    ml_dtypes on host to halve the transfer size."""
+    import ml_dtypes
+    from jax.sharding import NamedSharding
+
+    np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16}.get(dtype, dtype)
+
+    def put(a, spec):
+        a = np.asarray(a).astype(np_dtype)
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree.map(
+        put, tree, spec_tree,
+        is_leaf=lambda x: isinstance(x, (np.ndarray, jnp.ndarray)),
+    )
+
+
+def load_llama(path: str, cfg: Optional[llama_lib.LlamaConfig] = None,
+               mesh=None, dtype=None):
+    """Load an HF llama snapshot; if `mesh` is given, each leaf is placed
+    with the model's TP/FSDP PartitionSpec as it is read — required for
+    models larger than one device's HBM (llama3-70b on v5e)."""
+    cfg = cfg or llama_config_from_hf(path)
+    dtype = dtype or cfg.dtype
+    sd = read_safetensors_dir(path)
+    if mesh is not None:
+        tree = _llama_numpy_tree(sd, cfg)
+        params = shard_numpy_tree(tree, llama_lib.param_specs(cfg), mesh, dtype)
+    else:
+        params = llama_params_from_state_dict(sd, cfg, dtype=dtype)
+    return params, cfg

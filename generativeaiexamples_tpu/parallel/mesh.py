@@ -37,11 +37,13 @@ MESH_AXIS_NAMES = ("pipeline", "data", "fsdp", "expert", "sequence", "tensor")
 def _resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> dict:
     if cfg.ici_data == -1 and cfg.dcn_data == -1:
         raise ValueError("only one of ici_data/dcn_data may be -1")
+    data_fixed_factor = 1
     if cfg.ici_data == -1 or cfg.dcn_data == -1:
         # The "data" mesh axis is the ici*dcn product; a wildcard in either
-        # factor makes the combined axis the wildcard (the fixed factor is
-        # folded back in by the divisibility check below).
-        data = -1 if (cfg.ici_data * cfg.dcn_data) < 0 else cfg.ici_data * cfg.dcn_data
+        # factor makes the combined axis the wildcard. The fixed factor must
+        # still divide the filled size (checked after resolution below).
+        data = -1
+        data_fixed_factor = cfg.dcn_data if cfg.ici_data == -1 else cfg.ici_data
     else:
         data = cfg.ici_data * cfg.dcn_data
     sizes = {
@@ -68,6 +70,12 @@ def _resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> dict:
         raise ValueError(
             f"mesh axes product {fixed} != device count {n_devices}; "
             f"set one axis to -1 to auto-fill"
+        )
+    if sizes["data"] % data_fixed_factor:
+        raise ValueError(
+            f"resolved data axis {sizes['data']} not divisible by the fixed "
+            f"data factor {data_fixed_factor} (ici_data={cfg.ici_data}, "
+            f"dcn_data={cfg.dcn_data})"
         )
     return sizes
 
@@ -175,5 +183,12 @@ def maybe_initialize_distributed() -> None:
     collectives, which is strictly worse than crashing at startup."""
     import os
 
-    if jax.process_count() == 1 and os.environ.get("JAX_COORDINATOR_ADDRESS"):
+    # Check the env BEFORE touching any jax API: process_count() would
+    # initialize the local backend, after which distributed.initialize()
+    # unconditionally raises ("must be called before any JAX calls").
+    if not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.client is None:  # not yet initialized
         jax.distributed.initialize()

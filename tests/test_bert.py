@@ -1,0 +1,64 @@
+"""BERT encoder: golden logits vs HF transformers + pooling/reranker heads."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.models import bert
+from generativeaiexamples_tpu.models.hf_loader import bert_params_from_state_dict
+
+TINY = bert.BertConfig.tiny()
+
+
+def test_forward_shapes_and_normalization():
+    params = bert.init_params(TINY, jax.random.PRNGKey(0))
+    toks = jnp.zeros((3, 16), jnp.int32)
+    hidden, pooled = bert.forward(params, TINY, toks)
+    assert hidden.shape == (3, 16, TINY.dim)
+    assert pooled.shape == (3, TINY.dim)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(pooled), axis=-1),
+                               1.0, atol=1e-5)
+
+
+def test_padding_does_not_change_embedding():
+    params = bert.init_params(TINY, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 10), 0, TINY.vocab_size)
+    _, a = bert.forward(params, TINY, toks, lengths=jnp.array([10]))
+    padded = jnp.pad(toks, ((0, 0), (0, 6)))
+    _, b = bert.forward(params, TINY, padded, lengths=jnp.array([10]))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_cross_encoder_head_shape():
+    cfg = bert.BertConfig(vocab_size=128, dim=32, n_layers=2, n_heads=2,
+                          mlp_dim=64, max_position=64, n_labels=1)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.zeros((4, 12), jnp.int32)
+    _, scores = bert.forward(params, cfg, toks)
+    assert scores.shape == (4, 1)
+
+
+def test_golden_vs_hf_bert():
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig as HFConfig, BertModel
+
+    hf_cfg = HFConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.dim,
+        num_hidden_layers=TINY.n_layers, num_attention_heads=TINY.n_heads,
+        intermediate_size=TINY.mlp_dim,
+        max_position_embeddings=TINY.max_position,
+        layer_norm_eps=TINY.ln_eps, type_vocab_size=TINY.type_vocab_size,
+    )
+    with torch.no_grad():
+        model = BertModel(hf_cfg).eval()
+        sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    ours = bert_params_from_state_dict(sd, TINY)
+
+    toks = np.random.default_rng(0).integers(0, TINY.vocab_size, (2, 9))
+    attn = np.ones_like(toks)
+    with torch.no_grad():
+        hf_hidden = model(torch.tensor(toks),
+                          attention_mask=torch.tensor(attn)).last_hidden_state.numpy()
+    hidden, _ = bert.forward(ours, TINY, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(hidden), hf_hidden, atol=2e-4)
