@@ -70,6 +70,7 @@ class EmbeddingConfig:
     model_engine: str = "tpu"  # tpu | openai | hash (hermetic test fake)
     dimensions: int = 1024
     server_url: str = ""
+    weights_path: str = ""  # HF snapshot dir for the encoder weights
 
 
 @dataclass(frozen=True)
@@ -81,6 +82,7 @@ class RerankerConfig:
     model_engine: str = "tpu"  # tpu | openai | overlap (test fake)
     server_url: str = ""
     enabled: bool = False
+    weights_path: str = ""  # HF snapshot dir for the cross-encoder weights
 
 
 @dataclass(frozen=True)

@@ -192,6 +192,32 @@ def shard_numpy_tree(tree, spec_tree, mesh, dtype):
     )
 
 
+def bert_config_from_hf(path: str, n_labels: int = 0) -> bert_lib.BertConfig:
+    with open(os.path.join(path, "config.json")) as fh:
+        c = json.load(fh)
+    return bert_lib.BertConfig(
+        vocab_size=c["vocab_size"],
+        dim=c["hidden_size"],
+        n_layers=c["num_hidden_layers"],
+        n_heads=c["num_attention_heads"],
+        mlp_dim=c["intermediate_size"],
+        max_position=c.get("max_position_embeddings", 512),
+        type_vocab_size=c.get("type_vocab_size", 2),
+        ln_eps=c.get("layer_norm_eps", 1e-12),
+        n_labels=n_labels,
+    )
+
+
+def load_bert(path: str, cfg: Optional[bert_lib.BertConfig] = None,
+              n_labels: int = 0, dtype=None):
+    """Load an HF BERT-family snapshot (embedder: n_labels=0; cross-
+    encoder reranker: n_labels=1)."""
+    cfg = cfg or bert_config_from_hf(path, n_labels=n_labels)
+    sd = read_safetensors_dir(path)
+    params = bert_params_from_state_dict(sd, cfg, dtype=dtype)
+    return params, cfg
+
+
 def load_llama(path: str, cfg: Optional[llama_lib.LlamaConfig] = None,
                mesh=None, dtype=None):
     """Load an HF llama snapshot; if `mesh` is given, each leaf is placed

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from generativeaiexamples_tpu.ops import attention as attn_ops
+from generativeaiexamples_tpu.ops.quant import mm
 from generativeaiexamples_tpu.parallel.mesh import LLM_RULES, logical_to_spec
 
 Params = Dict[str, Any]
@@ -186,9 +187,9 @@ def _layer(cfg: LlamaConfig, x, ln1, ln2, wq, wk, wv, wo, w_gate, w_up, w_down,
     H, KH, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, ln1, cfg.rms_eps)
-    q = (h @ wq).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
-    k = (h @ wk).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
-    v = (h @ wv).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
+    q = mm(h, wq).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+    k = mm(h, wk).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
+    v = mm(h, wv).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
@@ -209,9 +210,9 @@ def _layer(cfg: LlamaConfig, x, ln1, ln2, wq, wk, wv, wo, w_gate, w_up, w_down,
         new_kv = (kc, vc)
 
     out = out.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
-    x = x + out @ wo
+    x = x + mm(out, wo)
     h = rms_norm(x, ln2, cfg.rms_eps)
-    x = x + (jax.nn.silu(h @ w_gate) * (h @ w_up)) @ w_down
+    x = x + mm(jax.nn.silu(mm(h, w_gate)) * mm(h, w_up), w_down)
     return x, new_kv
 
 
@@ -267,8 +268,10 @@ def forward(
         x, kv_out = jax.lax.scan(body, x, (weights, None))
 
     x = rms_norm(x, params["ln_f"], cfg.rms_eps)
-    head = (params["tok_emb"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        logits = (x @ params["tok_emb"].T.astype(x.dtype)).astype(jnp.float32)
+    else:
+        logits = mm(x, params["lm_head"]).astype(jnp.float32)
 
     new_cache = None
     if kv_cache is not None:

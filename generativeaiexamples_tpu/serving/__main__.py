@@ -1,0 +1,115 @@
+"""Engine server launcher: `python -m generativeaiexamples_tpu.serving`.
+
+Replaces the NIM/NeMo-Retriever container entrypoints. Configured via
+the AppConfig tree (APP_* env / --config file):
+
+  engine.weights_path   HF snapshot dir (empty => random-init tiny model,
+                        the hermetic/dev mode — no weights, no network)
+  llm.model_name        served model id
+  engine.quantize_weights  "int8" to quantize at load
+
+Serves /v1/chat/completions, /v1/completions, /v1/embeddings,
+/v1/ranking, /health, /metrics on one port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from generativeaiexamples_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax  # noqa: E402
+
+
+def build_engines(cfg, model_size: str = "tiny"):
+    from generativeaiexamples_tpu.models import bert, llama
+    from generativeaiexamples_tpu.ops.quant import quantize_llama_params
+    from generativeaiexamples_tpu.serving.encoders import (
+        EmbeddingEngine, RerankEngine)
+    from generativeaiexamples_tpu.serving.engine import LLMEngine
+    from generativeaiexamples_tpu.utils.tokenizer import load_tokenizer
+
+    if cfg.engine.weights_path:
+        from generativeaiexamples_tpu.models.hf_loader import load_llama
+
+        params, lcfg = load_llama(cfg.engine.weights_path)
+        tokenizer = load_tokenizer(cfg.engine.weights_path)
+    else:
+        geometry = {
+            "tiny": llama.LlamaConfig.tiny,
+            "1b": llama.LlamaConfig.llama3_2_1b,
+            "8b": llama.LlamaConfig.llama3_8b,
+            "70b": llama.LlamaConfig.llama3_70b,
+        }[model_size]
+        lcfg = geometry()
+        logging.warning("engine.weights_path empty: random-init %s model "
+                        "(dev/bench mode)", model_size)
+        params = llama.init_params(lcfg, jax.random.PRNGKey(0))
+        tokenizer = load_tokenizer("byte")
+
+    if cfg.engine.quantize_weights == "int8":
+        params = quantize_llama_params(params)
+
+    llm = LLMEngine(params, lcfg, tokenizer, cfg.engine).start()
+
+    hermetic = not cfg.engine.weights_path
+    # Encoders: real weights come from their OWN snapshots + tokenizers
+    # (a llama tokenizer against a BERT vocab would silently index out of
+    # range). Without weights: hermetic tiny random models in dev mode,
+    # disabled (None -> 503) when the LLM is real.
+    emb = rr = None
+    if cfg.embeddings.weights_path:
+        from generativeaiexamples_tpu.models.hf_loader import load_bert
+
+        bparams, bcfg = load_bert(cfg.embeddings.weights_path)
+        emb = EmbeddingEngine(bparams, bcfg,
+                              load_tokenizer(cfg.embeddings.weights_path))
+    elif hermetic:
+        bcfg = bert.BertConfig.tiny(vocab_size=512)
+        emb = EmbeddingEngine(bert.init_params(bcfg, jax.random.PRNGKey(1)),
+                              bcfg, tokenizer)
+    if cfg.reranker.weights_path:
+        from generativeaiexamples_tpu.models.hf_loader import load_bert
+
+        rparams, rcfg = load_bert(cfg.reranker.weights_path, n_labels=1)
+        rr = RerankEngine(rparams, rcfg,
+                          load_tokenizer(cfg.reranker.weights_path))
+    elif hermetic:
+        rcfg = bert.BertConfig(vocab_size=512, dim=32, n_layers=2,
+                               n_heads=2, mlp_dim=64, max_position=64,
+                               n_labels=1)
+        rr = RerankEngine(bert.init_params(rcfg, jax.random.PRNGKey(2)),
+                          rcfg, tokenizer)
+    return llm, emb, rr
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--config", default=None, help="YAML/JSON config file")
+    ap.add_argument("--model-size", default="tiny",
+                    choices=("tiny", "1b", "8b", "70b"),
+                    help="geometry when engine.weights_path is empty")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from generativeaiexamples_tpu.config.wizard import load_config
+    from generativeaiexamples_tpu.serving.openai_server import (
+        OpenAIServer, run_server)
+
+    cfg = load_config(args.config)
+    llm, emb, rr = build_engines(cfg, args.model_size)
+    server = OpenAIServer(llm, emb, rr, model_name=cfg.llm.model_name,
+                          embed_model_name=cfg.embeddings.model_name)
+    logging.info("engine server on %s:%d (backend=%s)", args.host, args.port,
+                 jax.default_backend())
+    run_server(server, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Embedding + reranking engines: TPU-native NeMo Retriever replacement.
+
+The reference runs two Triton microservices (embedding `NV-Embed-QA`,
+reranking `nv-rerank-qa-mistral-4b`; docker-compose-nim-ms.yaml:24-84)
+reached over HTTP. Here both are in-process JAX engines over the
+models.bert encoder, with bucketed padding so each (batch, seq) shape
+compiles once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.models import bert
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def _specials(tk):
+    """(cls_id, sep_id) if the tokenizer defines them (BERT-style), else
+    Nones (hermetic byte tokenizer)."""
+    return getattr(tk, "cls_id", None), getattr(tk, "sep_id", None)
+
+
+def _wrap(ids, cls_id, sep_id, limit):
+    """[CLS] ids [SEP], truncated to limit with specials preserved."""
+    extra = (cls_id is not None) + (sep_id is not None)
+    ids = list(ids)[: max(1, limit - extra)]
+    if cls_id is not None:
+        ids = [cls_id] + ids
+    if sep_id is not None:
+        ids = ids + [sep_id]
+    return ids
+
+
+class EmbeddingEngine:
+    """Batched text -> normalized vector encoder (arctic-embed recipe:
+    CLS pooling + L2 norm; query/document prefixes supported)."""
+
+    QUERY_PREFIX = "Represent this sentence for searching relevant passages: "
+
+    def __init__(self, params, cfg: bert.BertConfig, tokenizer,
+                 max_batch: int = 16, buckets: Sequence[int] = (32, 128, 512),
+                 use_pallas: Optional[bool] = None):
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.buckets = [min(b, cfg.max_position) for b in buckets]
+        self.use_pallas = use_pallas
+        self._lock = threading.Lock()
+        self._fwd = jax.jit(
+            lambda p, t, l: bert.forward(p, cfg, t, lengths=l,
+                                         use_pallas=use_pallas)[1])
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.dim
+
+    def _encode_ids(self, texts: Sequence[str]) -> List[List[int]]:
+        limit = self.buckets[-1]
+        cls_id, sep_id = _specials(self.tokenizer)
+        return [_wrap(self.tokenizer.encode(t), cls_id, sep_id, limit)
+                for t in texts]
+
+    def embed(self, texts: Sequence[str], is_query: bool = False) -> np.ndarray:
+        """[n] texts -> [n, D] float32 normalized embeddings."""
+        if not len(texts):
+            return np.zeros((0, self.cfg.dim), np.float32)
+        if is_query:
+            texts = [self.QUERY_PREFIX + t for t in texts]
+        ids = self._encode_ids(texts)
+        out = np.zeros((len(ids), self.cfg.dim), np.float32)
+        order = sorted(range(len(ids)), key=lambda i: len(ids[i]))
+        with self._lock:
+            for start in range(0, len(order), self.max_batch):
+                chunk = order[start: start + self.max_batch]
+                S = _bucket(max(len(ids[i]) for i in chunk) or 1, self.buckets)
+                toks = np.zeros((self.max_batch, S), np.int32)
+                lens = np.ones((self.max_batch,), np.int32)
+                for row, i in enumerate(chunk):
+                    n = max(1, len(ids[i]))
+                    toks[row, : len(ids[i])] = ids[i]
+                    lens[row] = n
+                vecs = np.asarray(self._fwd(self.params, jnp.asarray(toks),
+                                            jnp.asarray(lens)))
+                for row, i in enumerate(chunk):
+                    out[i] = vecs[row]
+        return out
+
+    def embed_query(self, text: str) -> np.ndarray:
+        return self.embed([text], is_query=True)[0]
+
+
+class RerankEngine:
+    """Cross-encoder (query, passage) -> relevance score, replacing the
+    reranking MS used by ranked_hybrid retrieval (fm-asr retriever.py:64)."""
+
+    def __init__(self, params, cfg: bert.BertConfig, tokenizer,
+                 max_batch: int = 8, buckets: Sequence[int] = (128, 256, 512),
+                 use_pallas: Optional[bool] = None):
+        assert cfg.n_labels >= 1, "reranker config must set n_labels"
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.buckets = [min(b, cfg.max_position) for b in buckets]
+        self._lock = threading.Lock()
+        self._fwd = jax.jit(
+            lambda p, t, l, tt: bert.forward(p, cfg, t, lengths=l,
+                                             token_types=tt,
+                                             use_pallas=use_pallas)[1])
+
+    def score(self, query: str, passages: Sequence[str]) -> np.ndarray:
+        """[n] passages -> [n] float32 relevance scores (higher=better)."""
+        if not len(passages):
+            return np.zeros((0,), np.float32)
+        limit = self.buckets[-1]
+        cls_id, sep_id = _specials(self.tokenizer)
+        q_ids = self.tokenizer.encode(query)
+        pairs: List[Tuple[List[int], int]] = []  # (ids, segment-B start)
+        for p in passages:
+            p_ids = self.tokenizer.encode(p)
+            # [CLS] q [SEP] p [SEP] — BERT sentence-pair convention
+            head = _wrap(q_ids, cls_id, sep_id, limit)
+            tail = list(p_ids)[: max(0, limit - len(head) - 1)]
+            if sep_id is not None and tail:
+                tail = tail + [sep_id]
+            pairs.append((head + tail, len(head)))
+        out = np.zeros((len(pairs),), np.float32)
+        with self._lock:
+            for start in range(0, len(pairs), self.max_batch):
+                chunk = pairs[start: start + self.max_batch]
+                S = _bucket(max(len(c[0]) for c in chunk) or 1, self.buckets)
+                toks = np.zeros((self.max_batch, S), np.int32)
+                lens = np.ones((self.max_batch,), np.int32)
+                types = np.zeros((self.max_batch, S), np.int32)
+                for row, (ids, sep) in enumerate(chunk):
+                    toks[row, : len(ids)] = ids
+                    lens[row] = max(1, len(ids))
+                    types[row, sep: len(ids)] = 1  # segment B = passage
+                scores = np.asarray(self._fwd(self.params, jnp.asarray(toks),
+                                              jnp.asarray(lens),
+                                              jnp.asarray(types)))
+                out[start: start + len(chunk)] = scores[: len(chunk), 0]
+        return out

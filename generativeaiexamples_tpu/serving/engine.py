@@ -1,0 +1,344 @@
+"""Continuous-batching LLM engine: the TPU-native NIM replacement.
+
+The reference delegates generation to TensorRT-LLM/Triton inside a NIM
+container reached over HTTP (common/utils.py:265-288); this engine is
+the in-process equivalent: paged KV cache, prefill/decode split,
+slot-based continuous batching, per-request sampling params and SSE-
+friendly token streams.
+
+Scheduling model (single scheduler thread, the only writer of slot and
+page state — SURVEY.md §5.2 calls out that the reference has no
+concurrency discipline; this one is explicit):
+
+  submit() -> waiting deque
+  loop:  admit waiting requests into free slots (one bucketed prefill
+         each, first token sampled immediately — TTFT = submit->here),
+         then one decode_step over ALL active slots (fixed batch shape,
+         inactive slots masked to the page-0 sink), sample, stream out,
+         retire finished slots.
+
+Shapes are always (bucket,) for prefill and (max_batch, max_pages) for
+decode, so steady state never recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.config.schema import EngineConfig
+from generativeaiexamples_tpu.models.llama import LlamaConfig
+from generativeaiexamples_tpu.serving import engine_model
+from generativeaiexamples_tpu.serving.kv_cache import (
+    PageAllocator, PagePool, SequencePages)
+from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
+from generativeaiexamples_tpu.utils.tokenizer import StreamDetokenizer
+
+_LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GenRequest:
+    prompt_ids: List[int]
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    stop_ids: Sequence[int] = ()
+    stream: "queue.Queue[Dict[str, Any]]" = dataclasses.field(
+        default_factory=queue.Queue)
+    submit_time: float = dataclasses.field(default_factory=time.perf_counter)
+    request_id: str = ""
+    cancelled: bool = False  # set by the server on client disconnect/stop
+
+
+class _Slot:
+    def __init__(self, req: GenRequest, seq: SequencePages, detok):
+        self.req = req
+        self.seq = seq
+        self.detok = detok
+        self.last_token: int = 0
+        self.generated = 0
+        self.prompt_len = len(req.prompt_ids)
+
+
+class EngineMetrics:
+    """Serving metrics (BASELINE.md north stars): TTFT, tokens/s, batch
+    occupancy. Lock-free reads, single-writer scheduler thread."""
+
+    def __init__(self):
+        # Bounded: p50/p95 over a sliding window, constant memory/scrape cost.
+        self.ttft_ms: deque = deque(maxlen=4096)
+        self.tokens_out = 0
+        self.decode_steps = 0
+        self.busy_slots_acc = 0
+        self.started = time.perf_counter()
+
+    def snapshot(self) -> Dict[str, Any]:
+        t = sorted(self.ttft_ms)
+        pct = lambda p: t[int(p * (len(t) - 1))] if t else None  # noqa: E731
+        occ = (self.busy_slots_acc / self.decode_steps
+               if self.decode_steps else 0.0)
+        dt = time.perf_counter() - self.started
+        return {
+            "ttft_p50_ms": pct(0.5), "ttft_p95_ms": pct(0.95),
+            "tokens_generated": self.tokens_out,
+            "decode_steps": self.decode_steps,
+            "mean_batch_occupancy": occ,
+            "tokens_per_sec": self.tokens_out / dt if dt else 0.0,
+        }
+
+
+class LLMEngine:
+    """Single-host engine over one jax device (or a mesh-replicated jit —
+    multi-chip sharding is applied to params/pool by the caller)."""
+
+    def __init__(self, params, cfg: LlamaConfig, tokenizer,
+                 engine_cfg: Optional[EngineConfig] = None,
+                 n_pages: Optional[int] = None, use_pallas: Optional[bool] = None):
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.ecfg = engine_cfg or EngineConfig()
+        self.use_pallas = use_pallas
+        ps = self.ecfg.page_size
+        if self.ecfg.max_seq_len < ps:
+            raise ValueError(
+                f"engine.max_seq_len {self.ecfg.max_seq_len} < page_size {ps}")
+        self.max_pages = self.ecfg.max_seq_len // ps
+        if n_pages is None:
+            n_pages = self.ecfg.max_batch_size * self.max_pages + 1
+        self.pool = PagePool.zeros(cfg, n_pages, ps,
+                                   dtype=jnp.dtype(self.ecfg.kv_dtype))
+        self.allocator = PageAllocator(n_pages)
+        self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
+        self.waiting: deque[GenRequest] = deque()
+        self.metrics = EngineMetrics()
+        # Buckets drive prefill_step's page-write reshape, so each must be a
+        # positive multiple of page_size within max_seq_len; invalid entries
+        # are rounded up / dropped here instead of crashing at first request.
+        max_bucket = self.max_pages * ps
+        rounded = {min(-(-b // ps) * ps, max_bucket)
+                   for b in self.ecfg.prefill_buckets if b > 0}
+        self.buckets = sorted(rounded) or [min(-(-512 // ps) * ps, max_bucket)]
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._rng = jax.random.PRNGKey(0)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "LLMEngine":
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> GenRequest:
+        max_prompt = self.buckets[-1]
+        if len(req.prompt_ids) > max_prompt:
+            # Context-budget behavior at the engine boundary (the reference
+            # caps message content at the API instead, server.py:63,85).
+            req.prompt_ids = req.prompt_ids[-max_prompt:]
+        with self._lock:
+            self.waiting.append(req)
+        self._wake.set()
+        return req
+
+    def generate_stream(self, prompt_ids: Sequence[int], **kw) -> Iterator[Dict]:
+        """Blocking iterator of {text, token_id, finished, ...} events."""
+        req = GenRequest(prompt_ids=list(prompt_ids), **kw)
+        self.submit(req)
+        while True:
+            ev = req.stream.get()
+            yield ev
+            if ev["finished"]:
+                return
+
+    def generate(self, prompt_ids: Sequence[int], **kw) -> str:
+        return "".join(ev["text"] for ev in self.generate_stream(prompt_ids, **kw))
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _free_slot_index(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _next_key(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _loop(self) -> None:
+        while self._running:
+            did_work = False
+            # Admission: prefill waiting requests into free slots.
+            while True:
+                with self._lock:
+                    if not self.waiting:
+                        break
+                    slot_idx = self._free_slot_index()
+                    if slot_idx is None:
+                        break
+                    req = self.waiting.popleft()
+                try:
+                    self._prefill(req, slot_idx)
+                    did_work = True
+                except MemoryError as e:
+                    _LOG.warning("admission failed (%s); requeueing", e)
+                    with self._lock:
+                        self.waiting.appendleft(req)
+                    break
+                except Exception:
+                    # A bad request must not kill the scheduler thread:
+                    # fail it and keep serving (SURVEY.md §5.3 pattern).
+                    _LOG.exception("prefill failed; failing request")
+                    req.stream.put({"text": "", "token_id": -1,
+                                    "finished": True, "finish_reason": "error"})
+            # One decode step over the active batch.
+            if any(s is not None for s in self.slots):
+                try:
+                    self._decode()
+                except Exception:
+                    # Device-side decode failure poisons the whole batch
+                    # (cache state unknown): fail all active slots, keep
+                    # the engine alive for new requests.
+                    _LOG.exception("decode step failed; failing active batch")
+                    for i, s in enumerate(self.slots):
+                        if s is not None:
+                            self._finish(i, "error")
+                did_work = True
+            if not did_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _prefill(self, req: GenRequest, slot_idx: int) -> None:
+        ids = req.prompt_ids or [0]
+        bucket = self._bucket_for(len(ids))
+        ps = self.pool.page_size
+        seq = SequencePages(self.allocator, ps, self.max_pages)
+        seq.ensure(len(ids))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(ids)] = ids
+        row = np.zeros((bucket // ps,), np.int32)
+        row[: len(seq.pages)] = seq.pages
+        logits, self.pool = engine_model.prefill_step(
+            self.params, self.cfg, self.pool, jnp.asarray(tokens),
+            jnp.int32(len(ids)), jnp.asarray(row), self.use_pallas)
+        sp = SamplingParams.make(1, req.temperature, req.top_p, req.top_k)
+        tok = int(sample(logits[None, :], sp, self._next_key())[0])
+        detok = StreamDetokenizer(self.tokenizer)
+        slot = _Slot(req, seq, detok)
+        slot.last_token = tok
+        self.slots[slot_idx] = slot
+        self.metrics.ttft_ms.append(
+            (time.perf_counter() - req.submit_time) * 1e3)
+        self._emit(slot, tok)
+
+    def _decode(self) -> None:
+        B = len(self.slots)
+        tokens = np.zeros((B,), np.int32)
+        lengths = np.ones((B,), np.int32)
+        tables = np.zeros((B, self.max_pages), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        active: List[int] = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if s.req.cancelled:
+                self._finish(i, "cancelled")
+                continue
+            new_len = s.seq.length + 1  # position of the incoming token
+            try:
+                s.seq.ensure(new_len)
+            except MemoryError:
+                self._finish(i, "length")  # out of pages: stop this request
+                continue
+            active.append(i)
+            tokens[i] = s.last_token
+            lengths[i] = new_len
+            tables[i] = s.seq.table_row()
+            temps[i] = s.req.temperature
+            top_ps[i] = s.req.top_p
+            top_ks[i] = s.req.top_k
+        if not active:
+            return
+        logits, self.pool = engine_model.decode_step(
+            self.params, self.cfg, self.pool, jnp.asarray(tokens),
+            jnp.asarray(tables), jnp.asarray(lengths), self.use_pallas)
+        sp = SamplingParams(jnp.asarray(temps), jnp.asarray(top_ps),
+                            jnp.asarray(top_ks))
+        next_tokens = np.asarray(sample(logits, sp, self._next_key()))
+        self.metrics.decode_steps += 1
+        self.metrics.busy_slots_acc += len(active)
+        for i in active:
+            s = self.slots[i]
+            s.last_token = int(next_tokens[i])
+            self._emit(s, s.last_token, slot_idx=i)
+
+    def _emit(self, slot: _Slot, tok: int, slot_idx: Optional[int] = None) -> None:
+        self.metrics.tokens_out += 1
+        slot.generated += 1
+        eos = (tok == getattr(self.tokenizer, "eos_id", None)
+               or tok in slot.req.stop_ids)
+        text = "" if eos else slot.detok.push(tok)
+        finished = eos or slot.generated >= slot.req.max_new_tokens
+        reason = ("stop" if eos else
+                  "length" if slot.generated >= slot.req.max_new_tokens else None)
+        slot.req.stream.put({
+            "text": text, "token_id": tok, "finished": finished,
+            "finish_reason": reason,
+        })
+        if finished:
+            # Find our slot index (prefill emits before slot placement).
+            if slot_idx is None:
+                slot_idx = next((j for j, s in enumerate(self.slots) if s is slot),
+                                None)
+            if slot_idx is not None:
+                self._finish(slot_idx, reason or "stop", emit=False)
+            else:
+                slot.seq.release()
+                self._mark_done(slot)
+
+    def _finish(self, slot_idx: int, reason: str, emit: bool = True) -> None:
+        slot = self.slots[slot_idx]
+        if slot is None:
+            return
+        if emit:
+            slot.req.stream.put({"text": "", "token_id": -1, "finished": True,
+                                 "finish_reason": reason})
+        slot.seq.release()
+        self.slots[slot_idx] = None
+        self._mark_done(slot)
+        self._wake.set()
+
+    def _mark_done(self, slot: _Slot) -> None:
+        pass  # hook for obs; kept explicit for future span ends

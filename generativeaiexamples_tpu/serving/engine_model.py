@@ -1,0 +1,126 @@
+"""Paged llama forward: the jitted prefill/decode steps of the engine.
+
+Mirrors models.llama's transformer block (rms_norm/rope/mm are imported
+from there; the block math must stay in lockstep — tests assert paged
+forward == contiguous forward) but reads/writes the serving PagePool:
+
+- `prefill_step`: one sequence at a bucketed length S; causal flash
+  attention over the prompt; k/v written into the sequence's pages
+  (padding positions land in sink page 0); returns logits at the last
+  valid position.
+- `decode_step`: whole slot batch, one token each; k/v appended at
+  (page_table[len//ps], len%ps); paged attention over the pool.
+
+Both are shape-stable: prefill compiles once per bucket, decode once per
+(batch, max_pages) — no recompiles in steady state (SURVEY.md §7.4 #2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models.llama import (
+    LlamaConfig, rms_norm, rope)
+from generativeaiexamples_tpu.ops import attention as attn_ops
+from generativeaiexamples_tpu.ops.quant import mm
+from generativeaiexamples_tpu.serving.kv_cache import PagePool
+from generativeaiexamples_tpu.serving.paged_attention import (
+    paged_attention_dispatch)
+
+
+def _project_qkv(cfg: LlamaConfig, h, w, positions):
+    B, S, _ = h.shape
+    H, KH, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = mm(h, w["wq"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+    k = mm(h, w["wk"]).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
+    v = mm(h, w["wv"]).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
+    return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
+
+
+def _finish_block(cfg: LlamaConfig, x, out, w):
+    B, S, _ = x.shape
+    x = x + mm(out.transpose(0, 2, 1, 3).reshape(B, S, -1), w["wo"])
+    h = rms_norm(x, w["ln2"], cfg.rms_eps)
+    return x + mm(jax.nn.silu(mm(h, w["w_gate"])) * mm(h, w["w_up"]), w["w_down"])
+
+
+def _logits(cfg: LlamaConfig, params, x):
+    x = rms_norm(x, params["ln_f"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        return (x @ params["tok_emb"].T.astype(x.dtype)).astype(jnp.float32)
+    return mm(x, params["lm_head"]).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas"),
+                   donate_argnames=("pool",))
+def prefill_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    tokens: jax.Array,      # [1, S_bucket]
+    length: jax.Array,      # [] valid prompt tokens
+    table_row: jax.Array,   # [S_bucket // page_size] page ids (0-padded)
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, PagePool]:
+    """Prefill one sequence; returns (last-token logits [V], pool)."""
+    _, S = tokens.shape
+    ps = pool.page_size
+    npages = S // ps
+    KH, Hd = cfg.n_kv_heads, cfg.head_dim
+    positions = jnp.arange(S)[None, :]
+    lengths = length[None]
+
+    x = params["tok_emb"][tokens].astype(cfg.dtype)
+
+    def body(x, layer):
+        w, kp, vp = layer  # kp/vp: [P, KH, ps, Hd] for this layer
+        h = rms_norm(x, w["ln1"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, h, w, positions)
+        out = attn_ops.attention(q, k, v, causal=True, lengths=lengths,
+                                 use_pallas=use_pallas)
+        # write pages: [1, KH, S, Hd] -> [npages, KH, ps, Hd]
+        kw = k[0].reshape(KH, npages, ps, Hd).transpose(1, 0, 2, 3)
+        vw = v[0].reshape(KH, npages, ps, Hd).transpose(1, 0, 2, 3)
+        kp = kp.at[table_row].set(kw.astype(kp.dtype))
+        vp = vp.at[table_row].set(vw.astype(vp.dtype))
+        return _finish_block(cfg, x, out, w), (kp, vp)
+
+    x, (k_out, v_out) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
+    last = jnp.take_along_axis(
+        x, (length - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)  # [1,1,D]
+    logits = _logits(cfg, params, last)[0, 0]
+    return logits, PagePool(k_out, v_out, ps)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas"),
+                   donate_argnames=("pool",))
+def decode_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    tokens: jax.Array,       # [B] last sampled token per slot
+    page_tables: jax.Array,  # [B, maxp]
+    lengths: jax.Array,      # [B] tokens incl. the one being generated NOW
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, PagePool]:
+    """One decode step for the whole slot batch -> (logits [B, V], pool)."""
+    B = tokens.shape[0]
+    ps = pool.page_size
+    positions = (lengths - 1)[:, None]  # [B, 1]
+    page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]  # [B]
+    offset = (lengths - 1) % ps  # [B]
+
+    x = params["tok_emb"][tokens[:, None]].astype(cfg.dtype)  # [B, 1, D]
+
+    def body(x, layer):
+        w, kp, vp = layer
+        h = rms_norm(x, w["ln1"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, h, w, positions)  # q/k/v [B, *, 1, Hd]
+        kp = kp.at[page_idx, :, offset, :].set(k[:, :, 0, :].astype(kp.dtype))
+        vp = vp.at[page_idx, :, offset, :].set(v[:, :, 0, :].astype(vp.dtype))
+        out = paged_attention_dispatch(
+            q[:, :, 0, :], kp, vp, page_tables, lengths, use_pallas=use_pallas)
+        return _finish_block(cfg, x, out[:, :, None, :], w), (kp, vp)
+
+    x, (k_out, v_out) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
+    return _logits(cfg, params, x)[:, 0], PagePool(k_out, v_out, ps)

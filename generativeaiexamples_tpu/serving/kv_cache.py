@@ -1,0 +1,120 @@
+"""Paged KV cache: host-side page allocator + device page pool.
+
+The TPU-native replacement for what TRT-LLM's paged KV manager does
+inside NIM (invisible to the reference repo; SURVEY.md §2.3). Design:
+
+- Device: one page pool per model, k/v arrays [L, P, KH, page_size, Hd].
+  Page 0 is a reserved garbage sink — padding positions in bucketed
+  prefills and unused page-table slots point at it, so scatter/gather
+  never needs dynamic shapes.
+- Host: PageAllocator hands out page ids (plain Python free list — the
+  scheduler thread owns it; no device sync needed to allocate).
+- Page tables are [B, max_pages] int32 arrays shipped to the device each
+  step (tiny; rides along with the token ids).
+
+Sized so `bytes = L * P * page_size * KH * Hd * 2 dtypes * itemsize`;
+`PagePool.for_budget` picks P from an HBM byte budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.models.llama import LlamaConfig
+
+
+@dataclasses.dataclass
+class PagePool:
+    """Device-side page pool (a pytree leaf pair) + geometry."""
+
+    k: jax.Array  # [L, P, KH, page_size, Hd]
+    v: jax.Array
+    page_size: int
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @staticmethod
+    def zeros(cfg: LlamaConfig, n_pages: int, page_size: int = 64,
+              dtype=None) -> "PagePool":
+        dtype = dtype or cfg.dtype
+        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
+        return PagePool(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                        page_size)
+
+    @staticmethod
+    def for_budget(cfg: LlamaConfig, hbm_bytes: int, page_size: int = 64,
+                   dtype=None) -> "PagePool":
+        dtype = dtype or cfg.dtype
+        itemsize = jnp.dtype(dtype).itemsize
+        per_page = (cfg.n_layers * page_size * cfg.n_kv_heads * cfg.head_dim
+                    * 2 * itemsize)
+        n_pages = max(2, hbm_bytes // per_page)
+        return PagePool.zeros(cfg, int(n_pages), page_size, dtype)
+
+
+jax.tree_util.register_dataclass(
+    PagePool, data_fields=["k", "v"], meta_fields=["page_size"]
+)
+
+
+class PageAllocator:
+    """Host-side free list. Page 0 is never handed out (garbage sink)."""
+
+    def __init__(self, n_pages: int):
+        self.n_pages = n_pages
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"KV page pool exhausted: want {n}, have "
+                              f"{len(self._free)} of {self.n_pages}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            assert 0 < p < self.n_pages, p
+            self._free.append(p)
+
+
+class SequencePages:
+    """Page bookkeeping for one active sequence."""
+
+    def __init__(self, allocator: PageAllocator, page_size: int, max_pages: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.pages: List[int] = []
+        self.length = 0  # tokens written
+
+    def ensure(self, new_length: int) -> None:
+        """Grow the page list to cover new_length tokens."""
+        need = -(-new_length // self.page_size)  # ceil
+        if need > self.max_pages:
+            raise MemoryError(
+                f"sequence needs {need} pages > max_pages {self.max_pages}")
+        if need > len(self.pages):
+            self.pages.extend(self.allocator.alloc(need - len(self.pages)))
+        self.length = new_length
+
+    def table_row(self) -> np.ndarray:
+        row = np.zeros((self.max_pages,), np.int32)  # padding -> page 0
+        row[: len(self.pages)] = self.pages
+        return row
+
+    def release(self) -> None:
+        if self.pages:
+            self.allocator.free(self.pages)
+            self.pages = []
+        self.length = 0

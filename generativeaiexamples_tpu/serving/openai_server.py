@@ -1,0 +1,275 @@
+"""OpenAI-compatible HTTP surface over the TPU engines (aiohttp).
+
+Replaces the NIM containers' API exactly where the reference consumes it
+(ChatNVIDIA/NVIDIAEmbeddings point at `/v1`, common/utils.py:276,313):
+
+  POST /v1/chat/completions   (stream=SSE chunks or full JSON)
+  POST /v1/completions
+  POST /v1/embeddings
+  POST /v1/ranking            (NIM-style reranker: query + passages)
+  GET  /v1/models, /health, /metrics
+
+aiohttp (not FastAPI — not in the image, and the server is thin enough
+that a framework buys little). Blocking engine queues are bridged to the
+event loop with run_in_executor so one slow stream never blocks another.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+_LOG = logging.getLogger(__name__)
+
+
+def _sse(data: Any) -> bytes:
+    return f"data: {json.dumps(data) if not isinstance(data, str) else data}\n\n".encode()
+
+
+class StopStream:
+    """Stop-sequence matching over a token stream. Emitted text never
+    contains any part of a stop string, including a prefix that arrived
+    in an earlier SSE chunk (held back until disambiguated)."""
+
+    def __init__(self, stops):
+        self.stops = [s for s in stops if s]
+        self.full = ""
+        self.sent = 0
+
+    def push(self, new: str):
+        """-> (text_safe_to_emit, hit_stop)."""
+        self.full += new
+        for s in self.stops:
+            i = self.full.find(s)
+            if i >= 0:
+                emit = self.full[self.sent: i]
+                self.sent = i
+                return emit, True
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.full)), 0, -1):
+                if self.full.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        end = len(self.full) - hold
+        emit = self.full[self.sent: end] if end > self.sent else ""
+        self.sent = max(self.sent, end)
+        return emit, False
+
+
+class OpenAIServer:
+    def __init__(self, llm_engine=None, embed_engine=None, rerank_engine=None,
+                 model_name: str = "llama3-8b-instruct",
+                 embed_model_name: str = "snowflake-arctic-embed-l"):
+        self.llm = llm_engine
+        self.embed = embed_engine
+        self.rerank = rerank_engine
+        self.model_name = model_name
+        self.embed_model_name = embed_model_name
+        # Dedicated executor: each live stream parks one thread on a
+        # blocking queue.get; the default loop executor is far too small
+        # (min(32, cpu+4)) and shared, so streams would starve embeddings.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(max_workers=128,
+                                            thread_name_prefix="openai-srv")
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/health", self.handle_health),
+            web.get("/v1/models", self.handle_models),
+            web.post("/v1/chat/completions", self.handle_chat),
+            web.post("/v1/completions", self.handle_completions),
+            web.post("/v1/embeddings", self.handle_embeddings),
+            web.post("/v1/ranking", self.handle_ranking),
+            web.get("/metrics", self.handle_metrics),
+        ])
+
+    # -- helpers -----------------------------------------------------------
+
+    def _prompt_ids(self, body: Dict, chat: bool) -> list:
+        tk = self.llm.tokenizer
+        if chat:
+            text = tk.apply_chat_template(body["messages"],
+                                          add_generation_prompt=True)
+        else:
+            p = body.get("prompt", "")
+            text = p[0] if isinstance(p, list) else p
+        return tk.encode(text, add_bos=not chat)
+
+    def _gen_request(self, body: Dict, chat: bool):
+        from generativeaiexamples_tpu.serving.engine import GenRequest
+
+        return GenRequest(
+            prompt_ids=self._prompt_ids(body, chat),
+            max_new_tokens=int(body.get("max_tokens") or 128),
+            temperature=float(body.get("temperature") or 0.0),
+            top_p=float(body.get("top_p") or 1.0),
+            top_k=int(body.get("top_k") or 0),
+            request_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+        )
+
+    async def _events(self, req):
+        """Async iterator over engine events for one request."""
+        loop = asyncio.get_running_loop()
+        while True:
+            ev = await loop.run_in_executor(self._executor, req.stream.get)
+            yield ev
+            if ev["finished"]:
+                return
+
+    @staticmethod
+    def _stop_strings(body: Dict) -> list:
+        stop = body.get("stop") or []
+        return [stop] if isinstance(stop, str) else list(stop)
+
+    # -- handlers ----------------------------------------------------------
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        # Device liveness, not just process liveness (SURVEY.md §5.3).
+        import jax
+
+        try:
+            n = len(jax.devices())
+        except Exception as e:  # device lost (e.g. TPU preemption)
+            return web.json_response({"status": "unhealthy", "error": str(e)},
+                                     status=503)
+        return web.json_response({
+            "status": "healthy", "devices": n,
+            "engines": {"llm": self.llm is not None,
+                        "embedding": self.embed is not None,
+                        "reranking": self.rerank is not None},
+        })
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        models = []
+        if self.llm is not None:
+            models.append({"id": self.model_name, "object": "model"})
+        if self.embed is not None:
+            models.append({"id": self.embed_model_name, "object": "model"})
+        return web.json_response({"object": "list", "data": models})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        snap = self.llm.metrics.snapshot() if self.llm else {}
+        return web.json_response(snap)
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._generate(request, chat=True)
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._generate(request, chat=False)
+
+    async def _generate(self, request: web.Request, chat: bool) -> web.StreamResponse:
+        if self.llm is None:
+            return web.json_response({"error": "no LLM engine"}, status=503)
+        body = await request.json()
+        req = self._gen_request(body, chat)
+        stops = self._stop_strings(body)
+        stream = bool(body.get("stream"))
+        self.llm.submit(req)
+        created = int(time.time())
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def chunk(delta_text: str, finish: Optional[str]) -> Dict:
+            if chat:
+                choice = {"index": 0, "delta": (
+                    {"content": delta_text} if delta_text else {}),
+                    "finish_reason": finish}
+            else:
+                choice = {"index": 0, "text": delta_text, "finish_reason": finish}
+            return {"id": req.request_id, "object": obj, "created": created,
+                    "model": body.get("model", self.model_name),
+                    "choices": [choice]}
+
+        if stream:
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            matcher = StopStream(stops)
+            try:
+                async for ev in self._events(req):
+                    text, cut = matcher.push(ev["text"])
+                    if text:
+                        await resp.write(_sse(chunk(text, None)))
+                    if cut or ev["finished"]:
+                        req.cancelled = True
+                        await resp.write(_sse(chunk(
+                            "", "stop" if cut else ev["finish_reason"])))
+                        break
+            except (ConnectionResetError, asyncio.CancelledError):
+                req.cancelled = True
+                raise
+            await resp.write(_sse("[DONE]"))
+            await resp.write_eof()
+            return resp
+
+        # non-streaming
+        matcher = StopStream(stops)
+        full = ""
+        finish = None
+        n_tokens = 0
+        async for ev in self._events(req):
+            text, cut = matcher.push(ev["text"])
+            full += text
+            n_tokens += 1 if ev["token_id"] >= 0 else 0
+            finish = ev["finish_reason"]
+            if cut:
+                finish = "stop"
+                req.cancelled = True
+                break
+        msg = ({"message": {"role": "assistant", "content": full}}
+               if chat else {"text": full})
+        return web.json_response({
+            "id": req.request_id,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": created, "model": body.get("model", self.model_name),
+            "choices": [{**msg, "index": 0, "finish_reason": finish or "stop"}],
+            "usage": {"prompt_tokens": len(req.prompt_ids),
+                      "completion_tokens": n_tokens,
+                      "total_tokens": len(req.prompt_ids) + n_tokens},
+        })
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        if self.embed is None:
+            return web.json_response({"error": "no embedding engine"}, status=503)
+        body = await request.json()
+        inputs = body.get("input", [])
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        is_query = body.get("input_type") == "query"  # NIM extension
+        loop = asyncio.get_running_loop()
+        vecs = await loop.run_in_executor(
+            self._executor, lambda: self.embed.embed(inputs, is_query=is_query))
+        return web.json_response({
+            "object": "list",
+            "model": body.get("model", self.embed_model_name),
+            "data": [{"object": "embedding", "index": i, "embedding": v.tolist()}
+                     for i, v in enumerate(vecs)],
+            "usage": {"prompt_tokens": 0, "total_tokens": 0},
+        })
+
+    async def handle_ranking(self, request: web.Request) -> web.Response:
+        if self.rerank is None:
+            return web.json_response({"error": "no reranking engine"}, status=503)
+        body = await request.json()
+        query = body["query"]["text"] if isinstance(body.get("query"), dict) \
+            else body.get("query", "")
+        passages = [p["text"] if isinstance(p, dict) else p
+                    for p in body.get("passages", [])]
+        loop = asyncio.get_running_loop()
+        scores = await loop.run_in_executor(
+            self._executor, lambda: self.rerank.score(query, passages))
+        rankings = sorted(
+            ({"index": i, "logit": float(s)} for i, s in enumerate(scores)),
+            key=lambda r: -r["logit"])
+        return web.json_response({"rankings": rankings})
+
+
+def run_server(server: OpenAIServer, host: str = "0.0.0.0", port: int = 8000):
+    web.run_app(server.app, host=host, port=port, print=None)

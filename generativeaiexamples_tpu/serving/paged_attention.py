@@ -1,0 +1,174 @@
+"""Paged decode attention: XLA gather fallback + Pallas TPU kernel.
+
+The decode hot op (SURVEY.md §7.4 hard part #1): one new query token per
+sequence attends over that sequence's KV pages. The Pallas kernel never
+materializes the gathered KV — pages stream HBM->VMEM directly via
+scalar-prefetched page-table indices in the BlockSpec index_map (the
+JetStream-style pattern), with online softmax across page steps.
+
+Layouts (per layer):
+  q        [B, H, Hd]           one token per sequence
+  k_pages  [P, KH, ps, Hd]      device page pool slice for this layer
+  page_table [B, maxp] int32    page ids per sequence (0 = padding sink)
+  lengths  [B] int32            valid tokens (incl. the new one)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def paged_attention_reference(
+    q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+    page_table: jax.Array, lengths: jax.Array, *, scale: Optional[float] = None,
+) -> jax.Array:
+    """Gather-based paged attention (any backend; the numerics oracle)."""
+    B, H, Hd = q.shape
+    P, KH, ps, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    scale = scale if scale is not None else Hd ** -0.5
+
+    # [B, maxp, KH, ps, Hd] -> [B, KH, maxp*ps, Hd]
+    k = k_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
+    v = v_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
+
+    from generativeaiexamples_tpu.ops.attention import mha_reference
+
+    out = mha_reference(q[:, :, None, :], k, v, causal=False, lengths=lengths,
+                        scale=scale)
+    return out[:, :, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(
+    lengths_ref,  # scalar prefetch [B]
+    table_ref,  # scalar prefetch [B * maxp]
+    q_ref,  # [1, H, Hd]
+    k_ref,  # [1, KH, ps, Hd]  (page selected by index_map)
+    v_ref,
+    o_ref,  # [1, H, Hd]
+    m_ref,  # scratch [H, 128]
+    l_ref,  # scratch [H, 128]
+    acc_ref,  # scratch [H, Hd]
+    *,
+    scale: float,
+    page_size: int,
+    max_pages: int,
+    n_kv_heads: int,
+    group: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b]
+
+    @pl.when(p * page_size < length)
+    def _body():
+        KH, ps = n_kv_heads, page_size
+        H = KH * group
+        q = q_ref[0].astype(jnp.float32).reshape(KH, group, -1)  # [KH,g,Hd]
+        k = k_ref[0].astype(jnp.float32)  # [KH, ps, Hd]
+        v = v_ref[0].astype(jnp.float32)
+        # Batched over kv heads: [KH, g, Hd] x [KH, ps, Hd] -> [KH, g, ps]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        valid = pos < length
+        s = jnp.where(valid, s, NEG_INF)
+
+        s2 = s.reshape(H, ps)
+        valid2 = valid.reshape(H, ps)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.where(valid2, jnp.exp(s2 - m_new), 0.0)  # [H, ps]
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_ref[:, :1] + jnp.sum(pexp, axis=1, keepdims=True),
+            l_ref.shape)
+        # [KH, g, ps] x [KH, ps, Hd] -> [KH, g, Hd]
+        pv = jax.lax.dot_general(
+            pexp.reshape(KH, group, ps), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv.reshape(H, -1)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p == max_pages - 1)
+    def _finish():
+        denom = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+    page_table: jax.Array, lengths: jax.Array, *,
+    scale: Optional[float] = None, interpret: bool = False,
+) -> jax.Array:
+    """Pallas paged decode attention. See module docstring for layouts."""
+    if pltpu is None:
+        raise RuntimeError("Pallas TPU unavailable; use paged_attention_reference")
+    B, H, Hd = q.shape
+    P, KH, ps, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    group = H // KH
+    scale = scale if scale is not None else Hd ** -0.5
+
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, page_size=ps, max_pages=maxp,
+        n_kv_heads=KH, group=group,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, maxp),
+        in_specs=[
+            pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
+            pl.BlockSpec((1, KH, ps, Hd), lambda b, p, L, T: (T[b * maxp + p], 0, 0, 0)),
+            pl.BlockSpec((1, KH, ps, Hd), lambda b, p, L, T: (T[b * maxp + p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, Hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention_dispatch(q, k_pages, v_pages, page_table, lengths, *,
+                             scale=None, use_pallas: Optional[bool] = None):
+    use_pallas = (jax.default_backend() == "tpu") if use_pallas is None else use_pallas
+    if use_pallas and pltpu is not None:
+        return paged_attention(q, k_pages, v_pages, page_table, lengths, scale=scale)
+    return paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
+                                     scale=scale)

@@ -1,0 +1,61 @@
+"""Token sampling on device: greedy / temperature / top-k / top-p.
+
+Per-slot parameter arrays so one jitted step serves a heterogeneous
+continuous batch (each request keeps its own temperature/top_p, matching
+the reference's per-request llm_settings, common/server.py:270-274).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot [B]-shaped device arrays."""
+
+    temperature: jax.Array  # 0 => greedy
+    top_p: jax.Array  # 1.0 => disabled
+    top_k: jax.Array  # 0 => disabled
+
+    @staticmethod
+    def make(batch: int, temperature=0.0, top_p=1.0, top_k=0) -> "SamplingParams":
+        f = lambda v: jnp.full((batch,), v)  # noqa: E731
+        return SamplingParams(f(float(temperature)), f(float(top_p)),
+                              f(jnp.int32(top_k)).astype(jnp.int32))
+
+
+def _mask_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Keep the top_k[b] largest logits per row (0 = keep all)."""
+    V = logits.shape[-1]
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)
+    thresh = jnp.take_along_axis(sorted_l, (k - 1)[:, None], axis=-1)
+    return jnp.where(logits >= thresh, logits, -jnp.inf)
+
+
+def _mask_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus sampling mask: smallest set of tokens with cumulative
+    probability >= top_p[b]."""
+    sort_idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    sorted_l = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None]  # always keeps rank-0
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], sort_idx
+    ].set(keep_sorted)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
+def sample(logits: jax.Array, params: SamplingParams, key: jax.Array) -> jax.Array:
+    """logits [B, V] -> token ids [B]. temperature==0 rows are greedy."""
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / t
+    scaled = _mask_top_k(scaled, params.top_k)
+    scaled = _mask_top_p(scaled, params.top_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled)

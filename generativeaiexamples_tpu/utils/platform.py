@@ -1,0 +1,25 @@
+"""Platform selection helper.
+
+Some environments (the axon TPU tunnel) force their backend through
+jax.config at interpreter startup, which silently overrides the standard
+JAX_PLATFORMS env var. Entry points call `apply_platform_env()` first so
+the operator's env var wins again — `JAX_PLATFORMS=cpu python -m ...`
+must mean CPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass

@@ -1,0 +1,124 @@
+"""Tokenizers: HF-backed for real models, byte-level for hermetic tests.
+
+The reference never tokenizes an LLM prompt in-repo (NIM does it server-
+side); it only counts tokens for context budgeting via sentence-
+transformers (common/utils.py:100-122). Here the serving engine owns
+tokenization, so the interface carries everything serving needs:
+encode/decode, incremental detokenization for SSE streaming, and chat
+templating (llama3 header format).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Hermetic byte-level tokenizer: ids 0-255 are raw bytes, then
+    specials. Lets the whole engine/server stack run in tests with the
+    tiny random models (no tokenizer.json, no network)."""
+
+    def __init__(self, specials: Sequence[str] = ("<pad>", "<bos>", "<eos>")):
+        self.specials = {s: 256 + i for i, s in enumerate(specials)}
+        self.pad_id = self.specials.get("<pad>", 256)
+        self.bos_id = self.specials.get("<bos>", 257)
+        self.eos_id = self.specials.get("<eos>", 258)
+        self.vocab_size = 256 + len(specials)
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8", errors="replace"))
+        return ([self.bos_id] if add_bos else []) + ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: Sequence[Dict[str, str]],
+                            add_generation_prompt: bool = True) -> str:
+        parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """Wrapper over a HF `tokenizers.Tokenizer` (tokenizer.json)."""
+
+    LLAMA3_EOS = ("<|eot_id|>", "<|end_of_text|>")
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer
+
+        f = path if path.endswith(".json") else os.path.join(path, "tokenizer.json")
+        self.tk = Tokenizer.from_file(f)
+        self.vocab_size = self.tk.get_vocab_size()
+        self.bos_id = self._first_id(("<|begin_of_text|>", "<s>", "<bos>"))
+        self.eos_id = self._first_id(self.LLAMA3_EOS + ("</s>", "<eos>"))
+        self.pad_id = self._first_id(("<pad>", "<|finetune_right_pad_id|>")) or 0
+        # BERT-style specials (embedder/reranker tokenizers)
+        self.cls_id = self._first_id(("[CLS]",))
+        self.sep_id = self._first_id(("[SEP]",))
+
+    def _first_id(self, names) -> Optional[int]:
+        for n in names:
+            i = self.tk.token_to_id(n)
+            if i is not None:
+                return i
+        return None
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = self.tk.encode(text, add_special_tokens=False).ids
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tk.decode(list(ids), skip_special_tokens=True)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True) -> str:
+        """Llama3 instruct format (the flagship model family's template)."""
+        out = ["<|begin_of_text|>"]
+        for m in messages:
+            out.append(f"<|start_header_id|>{m['role']}<|end_header_id|>\n\n"
+                       f"{m['content']}<|eot_id|>")
+        if add_generation_prompt:
+            out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return "".join(out)
+
+
+class StreamDetokenizer:
+    """Incremental detokenization for SSE streaming: emits only complete
+    UTF-8 text, holding back bytes/tokens that might merge with the next
+    token (the per-token hot loop of SURVEY.md §3.2).
+
+    O(1) amortized per token: only a bounded tail window of ids is ever
+    re-decoded (never the whole history), so long generations don't slow
+    the scheduler thread down quadratically."""
+
+    WINDOW = 16
+
+    def __init__(self, tokenizer):
+        self.tk = tokenizer
+        self.window: List[int] = []
+        self.prev = ""  # decode(window) as of the last emit
+
+    def push(self, token_id: int) -> str:
+        self.window.append(token_id)
+        cur = self.tk.decode(self.window)
+        if cur.endswith("�"):  # incomplete utf-8 tail; wait for more
+            return ""
+        new = cur[len(self.prev):]
+        if len(self.window) > self.WINDOW:
+            self.window = self.window[-4:]
+            self.prev = self.tk.decode(self.window)
+        else:
+            self.prev = cur
+        return new
+
+
+def load_tokenizer(name_or_path: str):
+    """"byte" -> hermetic ByteTokenizer; else HF tokenizer dir/file."""
+    if name_or_path in ("", "byte", "test"):
+        return ByteTokenizer()
+    return HFTokenizer(name_or_path)

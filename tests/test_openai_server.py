@@ -1,0 +1,137 @@
+"""OpenAI-compatible server + encoder engines, hermetic (tiny models)."""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.config.schema import EngineConfig
+from generativeaiexamples_tpu.models import bert, llama
+from generativeaiexamples_tpu.serving.encoders import (
+    EmbeddingEngine, RerankEngine)
+from generativeaiexamples_tpu.serving.engine import LLMEngine
+from generativeaiexamples_tpu.serving.openai_server import OpenAIServer
+from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+TINY_LLM = llama.LlamaConfig.tiny()
+TINY_BERT = bert.BertConfig.tiny(vocab_size=512)
+
+
+@pytest.fixture(scope="module")
+def server():
+    tk = ByteTokenizer()
+    llm = LLMEngine(
+        llama.init_params(TINY_LLM, jax.random.PRNGKey(0)), TINY_LLM, tk,
+        EngineConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                     prefill_buckets=(16, 32)),
+        use_pallas=False).start()
+    emb = EmbeddingEngine(bert.init_params(TINY_BERT, jax.random.PRNGKey(1)),
+                          TINY_BERT, tk, max_batch=4, buckets=(16, 32))
+    rr_cfg = bert.BertConfig(vocab_size=512, dim=32, n_layers=2, n_heads=2,
+                             mlp_dim=64, max_position=64, n_labels=1)
+    rr = RerankEngine(bert.init_params(rr_cfg, jax.random.PRNGKey(2)), rr_cfg,
+                      tk, max_batch=4, buckets=(32, 64))
+    yield (llm, emb, rr)
+    llm.stop()
+
+
+def _client_call(engines, fn):
+    """Run an async test body against an in-process aiohttp TestClient.
+    The OpenAIServer (and its web.Application) is built inside the test's
+    event loop — aiohttp binds an Application to the loop that runs it."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    llm, emb, rr = engines
+
+    async def runner():
+        srv = OpenAIServer(llm, emb, rr, model_name="tiny-llama")
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_health_and_models(server):
+    async def body(c):
+        h = await (await c.get("/health")).json()
+        m = await (await c.get("/v1/models")).json()
+        return h, m
+
+    h, m = _client_call(server, body)
+    assert h["status"] == "healthy" and h["engines"]["llm"]
+    assert {x["id"] for x in m["data"]} == {"tiny-llama",
+                                            "snowflake-arctic-embed-l"}
+
+
+def test_chat_completion_non_streaming(server):
+    async def body(c):
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 5})
+        return r.status, await r.json()
+
+    status, data = _client_call(server, body)
+    assert status == 200
+    assert data["choices"][0]["message"]["role"] == "assistant"
+    assert data["usage"]["completion_tokens"] == 5
+
+
+def test_chat_completion_streaming_sse(server):
+    async def body(c):
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "stream": True})
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = (await r.read()).decode()
+        return raw
+
+    raw = _client_call(server, body)
+    frames = [ln[6:] for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    parsed = [json.loads(f) for f in frames[:-1]]
+    assert parsed[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+    assert all(p["object"] == "chat.completion.chunk" for p in parsed)
+
+
+def test_embeddings_endpoint(server):
+    async def body(c):
+        r = await c.post("/v1/embeddings", json={"input": ["abc", "defg"]})
+        return await r.json()
+
+    data = _client_call(server, body)
+    assert len(data["data"]) == 2
+    v = np.asarray(data["data"][0]["embedding"])
+    assert v.shape == (TINY_BERT.dim,)
+    np.testing.assert_allclose(np.linalg.norm(v), 1.0, atol=1e-4)
+
+
+def test_ranking_endpoint(server):
+    async def body(c):
+        r = await c.post("/v1/ranking", json={
+            "query": {"text": "what is a tpu"},
+            "passages": [{"text": "tpus are accelerators"},
+                         {"text": "bananas are yellow"},
+                         {"text": "tpu chips multiply matrices"}]})
+        return await r.json()
+
+    data = _client_call(server, body)
+    assert len(data["rankings"]) == 3
+    logits = [r["logit"] for r in data["rankings"]]
+    assert logits == sorted(logits, reverse=True)
+
+
+def test_embedding_engine_batching_order():
+    """Results must map back to input order despite length-sorted batching."""
+    tk = ByteTokenizer()
+    eng = EmbeddingEngine(bert.init_params(TINY_BERT, jax.random.PRNGKey(1)),
+                          TINY_BERT, tk, max_batch=2, buckets=(8, 16, 32))
+    texts = ["aaaaaaaaaaaaaaaaaaaaaaaa", "b", "cc ccc", "d" * 30, "e"]
+    got = eng.embed(texts)
+    one_by_one = np.stack([eng.embed([t])[0] for t in texts])
+    np.testing.assert_allclose(got, one_by_one, atol=1e-4)

@@ -1,0 +1,50 @@
+"""Stream plumbing: stop-sequence holdback + incremental detokenizer."""
+
+from generativeaiexamples_tpu.serving.openai_server import StopStream
+from generativeaiexamples_tpu.utils.tokenizer import (
+    ByteTokenizer, StreamDetokenizer)
+
+
+def test_stop_across_chunks_is_trimmed():
+    m = StopStream(["END"])
+    out = []
+    hits = []
+    for piece in ["hello ", "EN", "D world"]:
+        t, hit = m.push(piece)
+        out.append(t)
+        hits.append(hit)
+    assert "".join(out) == "hello "
+    assert hits == [False, False, True]
+
+
+def test_stop_prefix_false_alarm_released():
+    m = StopStream(["END"])
+    text = ""
+    for piece in ["aE", "N", "Q rest"]:  # "EN" was a false alarm
+        t, _ = m.push(piece)
+        text += t
+    assert text == "aENQ rest"
+
+
+def test_no_stops_passthrough():
+    m = StopStream([])
+    assert m.push("abc") == ("abc", False)
+
+
+def test_detokenizer_streams_all_text_o1_window():
+    tk = ByteTokenizer()
+    msg = "hello world, this is a long stream of text to detokenize!"
+    ids = tk.encode(msg)
+    d = StreamDetokenizer(tk)
+    out = "".join(d.push(i) for i in ids)
+    assert out == msg
+    assert len(d.window) <= StreamDetokenizer.WINDOW + 1
+
+
+def test_detokenizer_holds_incomplete_utf8():
+    tk = ByteTokenizer()
+    d = StreamDetokenizer(tk)
+    ids = tk.encode("héllo")  # é is 2 bytes
+    pieces = [d.push(i) for i in ids]
+    assert "".join(pieces) == "héllo"
+    assert "�" not in "".join(pieces)
