@@ -1,0 +1,160 @@
+"""Benchmark: serving-engine decode throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+Headline metric: continuous-batching decode throughput (tokens/sec/chip)
+for the llama3-8b geometry, weight-only int8 (the deployment config for
+a 16 GB v5e chip), random-init weights (no weight downloads in this
+environment — throughput is weight-value-independent).
+
+Baseline: BASELINE.json north star >= 2000 tokens/sec/chip (the
+reference publishes no numbers — BASELINE.md).
+
+Env knobs: BENCH_MODEL (8b|1b|tiny), BENCH_BATCH, BENCH_PROMPT,
+BENCH_GEN, BENCH_PAGE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from generativeaiexamples_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _build_params_quantized(cfg, quantize: bool):
+    """Init weights host-side (numpy, layer-stacked), optionally int8-
+    quantize on host, then transfer — peak device memory never exceeds
+    the final footprint (an 8b bf16 init would OOM a 16 GB chip)."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    D, H, KH, Hd, M, L, V = (cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                             cfg.head_dim, cfg.mlp_dim, cfg.n_layers,
+                             cfg.vocab_size)
+
+    def w(*shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        a = (rng.standard_normal(shape, dtype=np.float32) * scale)
+        if quantize:
+            amax = np.abs(a).max(axis=-2, keepdims=True).clip(1e-8)
+            s = (amax / 127.0).astype(np.float32)
+            q = np.clip(np.round(a / s), -127, 127).astype(np.int8)
+            from generativeaiexamples_tpu.ops.quant import QuantizedTensor
+
+            return QuantizedTensor(jnp.asarray(q),
+                                   jnp.asarray(np.squeeze(s, axis=-2)))
+        return jnp.asarray(a.astype(ml_dtypes.bfloat16))
+
+    def vec(*shape):
+        return jnp.asarray(np.ones(shape, dtype=ml_dtypes.bfloat16))
+
+    params = {
+        "tok_emb": jnp.asarray(
+            (rng.standard_normal((V, D), dtype=np.float32) * 0.02
+             ).astype(ml_dtypes.bfloat16)),
+        "ln_f": vec(D),
+        "layers": {
+            "ln1": vec(L, D), "ln2": vec(L, D),
+            "wq": w(L, D, H * Hd), "wk": w(L, D, KH * Hd),
+            "wv": w(L, D, KH * Hd), "wo": w(L, H * Hd, D),
+            "w_gate": w(L, D, M), "w_up": w(L, D, M), "w_down": w(L, M, D),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(D, V, scale=D ** -0.5)
+    return params
+
+
+def main() -> None:
+    from generativeaiexamples_tpu.config.schema import EngineConfig
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.serving.engine import GenRequest, LLMEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    model = os.environ.get("BENCH_MODEL", "8b")
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+    gen = int(os.environ.get("BENCH_GEN", "128"))
+    page = int(os.environ.get("BENCH_PAGE", "64"))
+
+    cfg = {"8b": llama.LlamaConfig.llama3_8b,
+           "1b": llama.LlamaConfig.llama3_2_1b,
+           "tiny": llama.LlamaConfig.tiny}[model]()
+    quantize = model == "8b"  # deployment config for 16 GB HBM
+    t0 = time.perf_counter()
+    params = _build_params_quantized(cfg, quantize)
+    print(f"[bench] params built+transferred in {time.perf_counter()-t0:.1f}s "
+          f"(backend={jax.default_backend()}, quant={quantize})",
+          file=sys.stderr)
+
+    max_seq = prompt_len + gen + page
+    ecfg = EngineConfig(max_batch_size=batch, max_seq_len=max_seq,
+                        page_size=page, prefill_buckets=(prompt_len,),
+                        kv_dtype="bfloat16")
+    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg).start()
+
+    prompt = list(range(2, 2 + prompt_len))
+    # Warmup: compile prefill + decode once.
+    list(eng.generate_stream(prompt, max_new_tokens=4))
+    print("[bench] warmup done", file=sys.stderr)
+
+    results = []
+    lock = threading.Lock()
+
+    def worker():
+        n = 0
+        first = None
+        start = time.perf_counter()
+        for ev in eng.generate_stream(prompt, max_new_tokens=gen):
+            if ev["token_id"] >= 0:
+                if first is None:
+                    first = time.perf_counter() - start
+                n += 1
+        with lock:
+            results.append((n, first))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(batch)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    total_tokens = sum(n for n, _ in results)
+    ttfts = sorted(f for _, f in results if f is not None)
+    snap = eng.metrics.snapshot()
+    eng.stop()
+
+    tps = total_tokens / wall
+    out = {
+        "metric": f"decode_tokens_per_sec_per_chip_llama3_{model}"
+                  + ("_int8" if quantize else ""),
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tps / 2000.0, 3),
+        "extras": {
+            "batch": batch, "prompt_len": prompt_len, "gen": gen,
+            "wall_s": round(wall, 2),
+            "ttft_p50_ms": round(1e3 * ttfts[len(ttfts) // 2], 1) if ttfts else None,
+            "engine_metrics": {k: (round(v, 2) if isinstance(v, float) else v)
+                               for k, v in snap.items()},
+            "backend": jax.default_backend(),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

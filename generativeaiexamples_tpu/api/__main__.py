@@ -1,0 +1,3 @@
+from generativeaiexamples_tpu.api.server import main
+
+main()

@@ -1,0 +1,50 @@
+"""Connector protocols: what pipelines talk to.
+
+The reference reaches its engines via langchain_nvidia_ai_endpoints
+(`ChatNVIDIA`, `NVIDIAEmbeddings` — common/utils.py:265-318); here the
+seam is three small protocols, implemented by (a) in-process TPU engines,
+(b) any OpenAI-compatible remote URL, (c) hermetic fakes for tests —
+selected by config `model_engine` (tpu | openai | echo/hash/overlap).
+Pipelines never know which one they got.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Protocol, Sequence
+
+import numpy as np
+
+Message = Dict[str, str]  # {"role": ..., "content": ...}
+
+
+class ChatLLM(Protocol):
+    def stream_chat(self, messages: Sequence[Message], *, temperature: float = 0.2,
+                    top_p: float = 0.7, max_tokens: int = 1024,
+                    stop: Sequence[str] = ()) -> Iterator[str]:
+        """Yield response text deltas."""
+        ...
+
+    def chat(self, messages: Sequence[Message], **kw) -> str:
+        ...
+
+
+class Embedder(Protocol):
+    dim: int
+
+    def embed_documents(self, texts: Sequence[str]) -> np.ndarray:
+        ...
+
+    def embed_query(self, text: str) -> np.ndarray:
+        ...
+
+
+class Reranker(Protocol):
+    def score(self, query: str, passages: Sequence[str]) -> np.ndarray:
+        ...
+
+
+class ChatBase:
+    """chat() in terms of stream_chat() for all implementations."""
+
+    def chat(self, messages, **kw) -> str:
+        return "".join(self.stream_chat(messages, **kw))

@@ -1,0 +1,67 @@
+"""In-process connectors: pipelines -> TPU engines, zero HTTP hops.
+
+The reference pays three serialization hops per token (SURVEY.md §3.2
+hot loop); pointing the chain at the in-process engine collapses the
+chain-server->LLM hop entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from generativeaiexamples_tpu.connectors.base import ChatBase, Message
+
+
+class LocalEngineLLM(ChatBase):
+    """ChatLLM over an in-process serving.LLMEngine."""
+
+    def __init__(self, engine, tokenizer=None):
+        self.engine = engine
+        self.tokenizer = tokenizer or engine.tokenizer
+
+    def stream_chat(self, messages: Sequence[Message], *, temperature=0.2,
+                    top_p=0.7, max_tokens=1024, stop=()) -> Iterator[str]:
+        text = self.tokenizer.apply_chat_template(messages,
+                                                  add_generation_prompt=True)
+        ids = self.tokenizer.encode(text)
+        from generativeaiexamples_tpu.serving.openai_server import StopStream
+
+        matcher = StopStream(list(stop))
+        for ev in self.engine.generate_stream(
+                ids, max_new_tokens=max_tokens, temperature=temperature,
+                top_p=top_p):
+            piece, hit = matcher.push(ev["text"])
+            if piece:
+                yield piece
+            if hit:
+                return
+        tail = matcher.flush()
+        if tail:
+            yield tail
+
+
+class LocalEmbedder:
+    """Embedder over an in-process serving.EmbeddingEngine."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    @property
+    def dim(self) -> int:
+        return self.engine.dim
+
+    def embed_documents(self, texts: Sequence[str]) -> np.ndarray:
+        return self.engine.embed(list(texts), is_query=False)
+
+    def embed_query(self, text: str) -> np.ndarray:
+        return self.engine.embed([text], is_query=True)[0]
+
+
+class LocalReranker:
+    def __init__(self, engine):
+        self.engine = engine
+
+    def score(self, query: str, passages: Sequence[str]) -> np.ndarray:
+        return self.engine.score(query, passages)

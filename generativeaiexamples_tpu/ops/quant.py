@@ -75,21 +75,3 @@ def quantize_llama_params(params: dict) -> dict:
     if "lm_head" in params:
         out["lm_head"] = quantize_tensor(params["lm_head"], contract_axis=-2)
     return out
-
-
-def quantize_llama_specs(specs: dict) -> dict:
-    """PartitionSpec tree matching quantize_llama_params' output: q keeps
-    the weight's spec; s drops the contracted (-2) axis entry."""
-
-    def qspec(spec: P) -> QuantizedTensor:
-        s_spec = P(*(ax for i, ax in enumerate(spec) if i != len(spec) - 2))
-        return QuantizedTensor(q=spec, s=s_spec)  # type: ignore[arg-type]
-
-    out = dict(specs)
-    out["layers"] = {
-        k: (qspec(v) if k in LLAMA_QUANT_KEYS else v)
-        for k, v in specs["layers"].items()
-    }
-    if "lm_head" in specs:
-        out["lm_head"] = qspec(specs["lm_head"])
-    return out

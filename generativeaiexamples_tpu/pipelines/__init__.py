@@ -1,0 +1,6 @@
+"""Pipelines: the pluggable example layer (reference L5, SURVEY.md §1).
+
+Importing this package registers the built-in examples.
+"""
+
+from generativeaiexamples_tpu.pipelines import developer_rag  # noqa: F401

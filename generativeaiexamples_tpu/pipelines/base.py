@@ -1,0 +1,76 @@
+"""Pipeline interface + registry.
+
+Parity with the reference's BaseExample (common/base.py:21-33): every
+pipeline implements llm_chain / rag_chain / ingest_docs, optionally
+document_search / get_documents / delete_documents (duck-typed extras
+the server probes, common/server.py:345-427).
+
+Discovery: the reference walks a directory and imports the first class
+with the right methods (server.py:143-173, chosen by a Dockerfile COPY).
+Here pipelines self-register under a name and the server picks one by
+config/EXAMPLE_NAME env — same swap-ability, no filesystem magic.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Generator, List, Optional, Type
+
+_REGISTRY: Dict[str, Type["BaseExample"]] = {}
+
+
+def register_example(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.example_name = name
+        return cls
+    return deco
+
+
+def get_example_class(name: str) -> Type["BaseExample"]:
+    # Import the built-in pipelines so their registrations run.
+    import generativeaiexamples_tpu.pipelines as _p  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown example {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_examples() -> List[str]:
+    import generativeaiexamples_tpu.pipelines as _p  # noqa: F401
+
+    return sorted(_REGISTRY)
+
+
+class BaseExample(abc.ABC):
+    """One RAG pipeline. Instances are cheap (heavy state lives in the
+    shared resource container passed in)."""
+
+    example_name = "base"
+
+    def __init__(self, resources):
+        self.res = resources  # pipelines.resources.Resources
+
+    @abc.abstractmethod
+    def llm_chain(self, query: str, chat_history: List[Dict[str, str]],
+                  **llm_settings) -> Generator[str, None, None]:
+        """Answer without retrieval (reference base.py:22-24)."""
+
+    @abc.abstractmethod
+    def rag_chain(self, query: str, chat_history: List[Dict[str, str]],
+                  **llm_settings) -> Generator[str, None, None]:
+        """Answer grounded in the knowledge base (base.py:26-28)."""
+
+    @abc.abstractmethod
+    def ingest_docs(self, filepath: str, filename: str) -> None:
+        """Ingest one uploaded document (base.py:30-32)."""
+
+    # optional interface (server probes with hasattr)
+    def document_search(self, content: str, num_docs: int) -> List[Dict]:
+        raise NotImplementedError
+
+    def get_documents(self) -> List[str]:
+        raise NotImplementedError
+
+    def delete_documents(self, filenames: List[str]) -> bool:
+        raise NotImplementedError

@@ -1,0 +1,47 @@
+"""Shared pipeline resources: connectors, stores, splitter — built once.
+
+The reference builds these as module-level globals + lru_cache singletons
+scattered through utils.py (SURVEY.md §5.2 flags the pattern); here one
+explicit container owns them, built from config, injectable with fakes
+for hermetic tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from generativeaiexamples_tpu.config.schema import AppConfig
+
+
+class Resources:
+    def __init__(self, config: AppConfig, *, llm=None, embedder=None,
+                 reranker=None, store=None, conv_store=None, mesh=None):
+        from generativeaiexamples_tpu.connectors import factory
+        from generativeaiexamples_tpu.rag.retriever import Retriever
+        from generativeaiexamples_tpu.rag.splitter import get_text_splitter
+        from generativeaiexamples_tpu.rag.vectorstore import create_vector_store
+
+        self.config = config
+        self.llm = llm if llm is not None else factory.get_llm(config)
+        self.embedder = (embedder if embedder is not None
+                         else factory.get_embedder(config))
+        self.reranker = (reranker if reranker is not None
+                         else factory.get_reranker(config))
+        dim = getattr(self.embedder, "dim", config.embeddings.dimensions)
+        self.store = store if store is not None else create_vector_store(
+            config, dim=dim, mesh=mesh)
+        # second store for conversation memory (multi_turn_rag parity,
+        # chains.py:45-58 `conv_store`)
+        self.conv_store = conv_store if conv_store is not None else \
+            create_vector_store(config, dim=dim, mesh=mesh)
+        self.splitter = get_text_splitter(config)
+        self.retriever = Retriever(
+            self.store, self.embedder,
+            top_k=config.retriever.top_k,
+            score_threshold=config.retriever.score_threshold,
+            max_context_tokens=config.retriever.max_context_tokens,
+            reranker=self.reranker,
+        )
+        self._lock = threading.Lock()
+        self.extras: Dict = {}  # pipeline-private state (CSV registry etc.)

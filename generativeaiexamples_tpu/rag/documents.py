@@ -1,0 +1,65 @@
+"""Document loading: file -> text (+ metadata), by extension.
+
+Parity with the reference's loaders (PDFReader/UnstructuredReader in
+developer_rag chains.py:76-84; CSV registry in structured_data; HTML via
+bs4 in notebooks) using only bundled/pure-Python parsers:
+
+  .pdf        utils.pdf (pure-Python extractor)
+  .html/.htm  bs4 text extraction
+  .md/.txt/.py/.rst/...   plain text
+  .csv        returned raw (structured_data pipeline parses it)
+  .json       pretty-printed text
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+_LOG = logging.getLogger(__name__)
+
+TEXT_EXTS = {".txt", ".md", ".rst", ".py", ".log", ".yaml", ".yml", ".cfg",
+             ".ini", ".toml", ".csv", ".tsv"}
+
+
+@dataclass
+class Document:
+    text: str
+    metadata: Dict = field(default_factory=dict)
+
+
+def load_document(path: str, filename: str = "") -> List[Document]:
+    """One file -> list of page/sheet documents (metadata carries
+    filename + common_field parity, developer_rag chains.py:88-90)."""
+    name = filename or os.path.basename(path)
+    ext = os.path.splitext(name)[1].lower()
+    meta = {"filename": name, "source": path}
+    try:
+        if ext == ".pdf":
+            from generativeaiexamples_tpu.utils import pdf
+
+            pages = pdf.extract_text(path).split("\f")
+            return [Document(p, {**meta, "page": i})
+                    for i, p in enumerate(pages) if p.strip()]
+        if ext in (".html", ".htm"):
+            from bs4 import BeautifulSoup
+
+            with open(path, "r", encoding="utf-8", errors="replace") as fh:
+                soup = BeautifulSoup(fh.read(), "html.parser")
+            for tag in soup(["script", "style"]):
+                tag.decompose()
+            return [Document(soup.get_text(separator="\n"), meta)]
+        if ext == ".json":
+            with open(path, "r", encoding="utf-8", errors="replace") as fh:
+                return [Document(json.dumps(json.load(fh), indent=1), meta)]
+        if ext in TEXT_EXTS or ext == "":
+            with open(path, "r", encoding="utf-8", errors="replace") as fh:
+                return [Document(fh.read(), meta)]
+    except Exception:
+        _LOG.exception("failed to load %s", path)
+        return []
+    _LOG.warning("unsupported file type %s (%s); skipped", ext, name)
+    return []

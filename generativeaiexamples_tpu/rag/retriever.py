@@ -1,0 +1,147 @@
+"""Retriever: embed -> search -> (hybrid rerank) -> token budget.
+
+Combines the reference's retrieval behaviors in one place:
+- top_k + score_threshold retrieval (configuration.py:141-150), with the
+  no-threshold fallback the reference needs for Milvus
+  (multi_turn_rag/chains.py:189-219) expressed as threshold=None.
+- `LimitRetrievedNodesLength` parity: trim retrieved chunks to a token
+  budget, whole-chunk granularity (common/utils.py:100-122, 1500 cap).
+- `ranked_hybrid` parity (fm-asr retriever.py:64-110): dense + lexical
+  candidate union, cross-encoder rerank, stdev outlier dropping.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from generativeaiexamples_tpu.rag.splitter import ApproxTokenizer
+from generativeaiexamples_tpu.rag.vectorstore import SearchResult
+
+
+class BM25Lexical:
+    """Small BM25 over the store's documents for the hybrid candidate set
+    (the reference gets its lexical leg from NeMo Retriever's pipeline;
+    here it's in-process)."""
+
+    _tok = re.compile(r"\w+")
+
+    def __init__(self, k1: float = 1.5, b: float = 0.75):
+        self.k1, self.b = k1, b
+        self._docs: List[List[str]] = []
+        self._df: Counter = Counter()
+        self._avg = 0.0
+
+    def fit(self, texts: Sequence[str]) -> None:
+        self._docs = [self._tok.findall(t.lower()) for t in texts]
+        self._df = Counter()
+        for d in self._docs:
+            self._df.update(set(d))
+        self._avg = (sum(len(d) for d in self._docs) / len(self._docs)
+                     if self._docs else 0.0)
+
+    def scores(self, query: str) -> np.ndarray:
+        q = self._tok.findall(query.lower())
+        N = len(self._docs)
+        out = np.zeros((N,), np.float32)
+        for i, d in enumerate(self._docs):
+            tf = Counter(d)
+            s = 0.0
+            for w in q:
+                if w not in tf:
+                    continue
+                idf = math.log(1 + (N - self._df[w] + 0.5) / (self._df[w] + 0.5))
+                denom = tf[w] + self.k1 * (
+                    1 - self.b + self.b * len(d) / max(self._avg, 1e-9))
+                s += idf * tf[w] * (self.k1 + 1) / denom
+            out[i] = s
+        return out
+
+
+class Retriever:
+    """The retrieval stage every pipeline shares."""
+
+    def __init__(self, store, embedder, *, top_k: int = 4,
+                 score_threshold: Optional[float] = 0.25,
+                 max_context_tokens: int = 1500,
+                 reranker=None, token_counter=None):
+        self.store = store
+        self.embedder = embedder
+        self.top_k = top_k
+        self.score_threshold = score_threshold
+        self.max_context_tokens = max_context_tokens
+        self.reranker = reranker
+        self.tk = token_counter or ApproxTokenizer()
+
+    # -- core --------------------------------------------------------------
+
+    def retrieve(self, query: str, top_k: Optional[int] = None,
+                 with_threshold: bool = True) -> List[SearchResult]:
+        k = top_k or self.top_k
+        qv = self.embedder.embed_query(query)
+        results = self.store.search(
+            qv, top_k=k,
+            score_threshold=self.score_threshold if with_threshold else None)
+        if not results and with_threshold:
+            # Reference fallback: retry without score threshold
+            # (multi_turn_rag/chains.py:189-219).
+            results = self.store.search(qv, top_k=k, score_threshold=None)
+        return results
+
+    def retrieve_hybrid(self, query: str, top_k: Optional[int] = None,
+                        candidates: int = 20,
+                        drop_outliers: bool = True) -> List[SearchResult]:
+        """ranked_hybrid: dense ∪ BM25 candidates -> cross-encoder rerank
+        -> stdev outlier drop (fm-asr retriever.py:64,99-110)."""
+        k = top_k or self.top_k
+        dense = self.retrieve(query, top_k=candidates, with_threshold=False)
+        docs = self.store.snapshot_docs()  # consistent view vs. ingestion
+        merged = {r.text: r for r in dense}
+        if docs:
+            bm = BM25Lexical()
+            bm.fit([d["text"] for d in docs])
+            s = bm.scores(query)
+            for i in np.argsort(s)[::-1][:candidates]:
+                if s[i] <= 0:
+                    break
+                d = docs[int(i)]
+                merged.setdefault(
+                    d["text"],
+                    SearchResult(d["text"], float(s[i]), dict(d["metadata"])))
+        cands = list(merged.values())
+        if self.reranker is not None and cands:
+            scores = self.reranker.score(query, [c.text for c in cands])
+            for c, s in zip(cands, scores):
+                c.score = float(s)
+        cands.sort(key=lambda c: -c.score)
+        cands = cands[:k]
+        if drop_outliers and len(cands) > 2:
+            vals = np.array([c.score for c in cands])
+            keep = vals >= vals.mean() - vals.std()
+            cands = [c for c, kp in zip(cands, keep) if kp]
+        return cands
+
+    # -- context assembly --------------------------------------------------
+
+    def limit_tokens(self, results: Sequence[SearchResult],
+                     budget: Optional[int] = None) -> List[SearchResult]:
+        """Whole-chunk token budget (LimitRetrievedNodesLength parity)."""
+        budget = budget if budget is not None else self.max_context_tokens
+        out, used = [], 0
+        for r in results:
+            n = len(self.tk.encode(r.text))
+            if used + n > budget:
+                break
+            used += n
+            out.append(r)
+        return out
+
+    def context(self, query: str, hybrid: bool = False) -> str:
+        results = (self.retrieve_hybrid(query) if hybrid
+                   else self.retrieve(query))
+        results = self.limit_tokens(results)
+        return "\n\n".join(r.text for r in results)

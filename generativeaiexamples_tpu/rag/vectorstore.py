@@ -1,0 +1,224 @@
+"""Vector stores: exact MIPS over numpy / TPU, with durable persistence.
+
+Replaces the reference's external vector DBs (Milvus GPU_IVF_FLAT /
+pgvector; common/utils.py:158-243, docker-compose-vectordb.yaml). The
+primary backends are in-process:
+
+- MemoryVectorStore: numpy matmul top-k. Exact (recall 1.0 vs IVF's
+  approximate), fast to ~1M chunks on CPU.
+- TPUVectorStore: same interface, scores on the accelerator via
+  ops.topk (single-device or ShardedMIPSIndex over a mesh axis) —
+  the "TPU brute-force MIPS" option from SURVEY.md §7.4 item 6.
+
+Durability matches the reference's "ingested data persists across
+sessions" feature (CHANGELOG.md:63): save()/load() to a directory
+(vectors.npz + docs.jsonl).
+
+Documents carry {text, metadata{filename, ...}}; deletion is by
+filename, mirroring the reference's /documents DELETE semantics
+(common/server.py:402-427).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class SearchResult:
+    text: str
+    score: float
+    metadata: Dict = field(default_factory=dict)
+
+
+class MemoryVectorStore:
+    """Exact cosine/IP search over an [N, D] matrix. Thread-safe."""
+
+    def __init__(self, dim: int, metric: str = "ip"):
+        self.dim = dim
+        self.metric = metric  # "ip" (normalized embeddings) or "cosine"
+        self._vecs = np.zeros((0, dim), np.float32)
+        self._docs: List[Dict] = []
+        self._lock = threading.RLock()
+
+    # -- ingest ------------------------------------------------------------
+
+    def add(self, texts: Sequence[str], embeddings: np.ndarray,
+            metadatas: Optional[Sequence[Dict]] = None) -> List[int]:
+        embeddings = np.asarray(embeddings, np.float32)
+        assert embeddings.shape == (len(texts), self.dim), embeddings.shape
+        metadatas = metadatas or [{} for _ in texts]
+        with self._lock:
+            base = len(self._docs)
+            self._vecs = np.concatenate([self._vecs, embeddings])
+            for t, m in zip(texts, metadatas):
+                self._docs.append({"text": t, "metadata": dict(m)})
+            self._on_update()
+            return list(range(base, base + len(texts)))
+
+    # -- search ------------------------------------------------------------
+
+    def _scores(self, query: np.ndarray) -> np.ndarray:
+        q = np.asarray(query, np.float32)
+        if self.metric == "cosine":
+            qn = q / max(np.linalg.norm(q), 1e-12)
+            dn = self._vecs / np.clip(
+                np.linalg.norm(self._vecs, axis=1, keepdims=True), 1e-12, None)
+            return dn @ qn
+        return self._vecs @ q
+
+    def search(self, query_embedding: np.ndarray, top_k: int = 4,
+               score_threshold: Optional[float] = None) -> List[SearchResult]:
+        with self._lock:
+            if not self._docs:
+                return []
+            scores = self._scores(query_embedding)
+            k = min(top_k, len(scores))
+            idx = np.argpartition(scores, -k)[-k:]
+            idx = idx[np.argsort(scores[idx])[::-1]]
+            out = []
+            for i in idx:
+                s = float(scores[i])
+                if score_threshold is not None and s < score_threshold:
+                    continue
+                d = self._docs[i]
+                out.append(SearchResult(d["text"], s, dict(d["metadata"])))
+            return out
+
+    # -- document management ----------------------------------------------
+
+    def list_documents(self) -> List[str]:
+        with self._lock:
+            return sorted({d["metadata"].get("filename", "")
+                           for d in self._docs if d["metadata"].get("filename")})
+
+    def delete_documents(self, filenames: Sequence[str]) -> int:
+        names = set(filenames)
+        with self._lock:
+            keep = [i for i, d in enumerate(self._docs)
+                    if d["metadata"].get("filename") not in names]
+            removed = len(self._docs) - len(keep)
+            self._vecs = self._vecs[keep] if keep else np.zeros(
+                (0, self.dim), np.float32)
+            self._docs = [self._docs[i] for i in keep]
+            self._on_update()
+            return removed
+
+    def __len__(self) -> int:
+        return len(self._docs)
+
+    def snapshot_docs(self):
+        """Consistent copy of the doc list for lock-free downstream use
+        (hybrid retrieval's lexical leg)."""
+        with self._lock:
+            return list(self._docs)
+
+    # -- persistence (reference: data persists across sessions) -----------
+
+    def save(self, path: str) -> None:
+        with self._lock:
+            os.makedirs(path, exist_ok=True)
+            np.savez_compressed(os.path.join(path, "vectors.npz"),
+                                vecs=self._vecs)
+            with open(os.path.join(path, "docs.jsonl"), "w") as fh:
+                for d in self._docs:
+                    fh.write(json.dumps(d) + "\n")
+
+    @classmethod
+    def load(cls, path: str, dim: int, metric: str = "ip"):
+        store = cls(dim, metric)
+        vp = os.path.join(path, "vectors.npz")
+        dp = os.path.join(path, "docs.jsonl")
+        if os.path.isfile(vp) and os.path.isfile(dp):
+            store._vecs = np.load(vp)["vecs"].astype(np.float32)
+            with open(dp) as fh:
+                store._docs = [json.loads(ln) for ln in fh if ln.strip()]
+            store._on_update()
+        return store
+
+    def _on_update(self) -> None:
+        pass  # hook for device-side mirrors
+
+
+class TPUVectorStore(MemoryVectorStore):
+    """Same interface; scoring runs on the accelerator. The device copy
+    is refreshed lazily after mutations (ingest batches, then search)."""
+
+    def __init__(self, dim: int, metric: str = "ip", mesh=None,
+                 shard_axis: str = "tensor"):
+        self.mesh = mesh
+        self.shard_axis = shard_axis
+        self._device_index = None
+        self._dirty = True
+        super().__init__(dim, metric)
+
+    def _on_update(self) -> None:
+        self._dirty = True
+
+    def _refresh(self) -> None:
+        import jax.numpy as jnp
+
+        if not self._dirty:
+            return
+        vecs = self._vecs
+        if self.metric == "cosine":
+            vecs = vecs / np.clip(np.linalg.norm(vecs, axis=1, keepdims=True),
+                                  1e-12, None)
+        if self.mesh is not None and len(vecs):
+            from generativeaiexamples_tpu.ops.topk import ShardedMIPSIndex
+
+            self._device_index = ShardedMIPSIndex(jnp.asarray(vecs), self.mesh,
+                                                  self.shard_axis)
+        else:
+            self._device_index = jnp.asarray(vecs) if len(vecs) else None
+        self._dirty = False
+
+    def search(self, query_embedding: np.ndarray, top_k: int = 4,
+               score_threshold: Optional[float] = None) -> List[SearchResult]:
+        with self._lock:
+            if not self._docs:
+                return []
+            self._refresh()
+            q = np.asarray(query_embedding, np.float32)
+            if self.metric == "cosine":
+                q = q / max(np.linalg.norm(q), 1e-12)
+            k = min(top_k, len(self._docs))
+            if isinstance(self._device_index, object) and hasattr(
+                    self._device_index, "search"):
+                scores, idx = self._device_index.search(q[None, :], k)
+            else:
+                from generativeaiexamples_tpu.ops.topk import mips_topk
+
+                scores, idx = mips_topk(q[None, :], self._device_index, k)
+            out = []
+            for s, i in zip(np.asarray(scores)[0], np.asarray(idx)[0]):
+                if score_threshold is not None and float(s) < score_threshold:
+                    continue
+                d = self._docs[int(i)]
+                out.append(SearchResult(d["text"], float(s),
+                                        dict(d["metadata"])))
+            return out
+
+
+def create_vector_store(config, dim: Optional[int] = None, mesh=None):
+    """Factory from AppConfig.vector_store (parity: utils.py:158-243).
+    name: memory | tpu (in-process) — milvus/pgvector configs map to the
+    in-process stores with a warning when their client libs are absent."""
+    import logging
+
+    name = config.vector_store.name
+    dim = dim or config.embeddings.dimensions
+    if name in ("milvus", "pgvector"):
+        logging.getLogger(__name__).warning(
+            "vector_store %s: external DB clients not bundled; using the "
+            "in-process TPU-MIPS store (same API surface)", name)
+        name = "tpu"
+    if name in ("tpu", "native"):
+        return TPUVectorStore(dim, mesh=mesh)
+    return MemoryVectorStore(dim)

@@ -82,9 +82,15 @@ class EngineMetrics:
         self.decode_steps = 0
         self.busy_slots_acc = 0
         self.started = time.perf_counter()
+        self._lock = threading.Lock()  # scheduler appends vs scrape iterates
+
+    def record_ttft(self, ms: float) -> None:
+        with self._lock:
+            self.ttft_ms.append(ms)
 
     def snapshot(self) -> Dict[str, Any]:
-        t = sorted(self.ttft_ms)
+        with self._lock:
+            t = sorted(self.ttft_ms)
         pct = lambda p: t[int(p * (len(t) - 1))] if t else None  # noqa: E731
         occ = (self.busy_slots_acc / self.decode_steps
                if self.decode_steps else 0.0)
@@ -244,6 +250,16 @@ class LLMEngine:
         ps = self.pool.page_size
         seq = SequencePages(self.allocator, ps, self.max_pages)
         seq.ensure(len(ids))
+        try:
+            self._prefill_inner(req, slot_idx, seq, ids, bucket, ps)
+        except Exception:
+            # Pages must never leak on a failed prefill — a few failures
+            # would otherwise exhaust the pool and wedge admission forever.
+            seq.release()
+            raise
+
+    def _prefill_inner(self, req: GenRequest, slot_idx: int,
+                       seq: SequencePages, ids, bucket: int, ps: int) -> None:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, : len(ids)] = ids
         row = np.zeros((bucket // ps,), np.int32)
@@ -257,7 +273,7 @@ class LLMEngine:
         slot = _Slot(req, seq, detok)
         slot.last_token = tok
         self.slots[slot_idx] = slot
-        self.metrics.ttft_ms.append(
+        self.metrics.record_ttft(
             (time.perf_counter() - req.submit_time) * 1e3)
         self._emit(slot, tok)
 
@@ -307,8 +323,9 @@ class LLMEngine:
     def _emit(self, slot: _Slot, tok: int, slot_idx: Optional[int] = None) -> None:
         self.metrics.tokens_out += 1
         slot.generated += 1
-        eos = (tok == getattr(self.tokenizer, "eos_id", None)
-               or tok in slot.req.stop_ids)
+        eos_ids = getattr(self.tokenizer, "eos_ids", None) or \
+            {getattr(self.tokenizer, "eos_id", None)}
+        eos = tok in eos_ids or tok in slot.req.stop_ids
         text = "" if eos else slot.detok.push(tok)
         finished = eos or slot.generated >= slot.req.max_new_tokens
         reason = ("stop" if eos else

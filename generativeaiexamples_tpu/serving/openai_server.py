@@ -62,6 +62,14 @@ class StopStream:
         self.sent = max(self.sent, end)
         return emit, False
 
+    def flush(self) -> str:
+        """Release held-back text (a stop-prefix false alarm) at end of
+        generation — without this, output ending in a proper prefix of a
+        stop string would be silently truncated."""
+        out = self.full[self.sent:]
+        self.sent = len(self.full)
+        return out
+
 
 class OpenAIServer:
     def __init__(self, llm_engine=None, embed_engine=None, rerank_engine=None,
@@ -99,7 +107,16 @@ class OpenAIServer:
                                           add_generation_prompt=True)
         else:
             p = body.get("prompt", "")
-            text = p[0] if isinstance(p, list) else p
+            if isinstance(p, list):
+                if p and all(isinstance(x, int) for x in p):
+                    return list(p)  # pre-tokenized prompt
+                if len(p) != 1 or not isinstance(p[0], str):
+                    raise web.HTTPUnprocessableEntity(
+                        text=json.dumps({"detail": "prompt must be a string, "
+                                         "[string], or [token ids]"}),
+                        content_type="application/json")
+                p = p[0]
+            text = p
         return tk.encode(text, add_bos=not chat)
 
     def _gen_request(self, body: Dict, chat: bool):
@@ -199,6 +216,10 @@ class OpenAIServer:
                         await resp.write(_sse(chunk(text, None)))
                     if cut or ev["finished"]:
                         req.cancelled = True
+                        if not cut:
+                            tail = matcher.flush()
+                            if tail:
+                                await resp.write(_sse(chunk(tail, None)))
                         await resp.write(_sse(chunk(
                             "", "stop" if cut else ev["finish_reason"])))
                         break
@@ -214,15 +235,21 @@ class OpenAIServer:
         full = ""
         finish = None
         n_tokens = 0
-        async for ev in self._events(req):
-            text, cut = matcher.push(ev["text"])
-            full += text
-            n_tokens += 1 if ev["token_id"] >= 0 else 0
-            finish = ev["finish_reason"]
-            if cut:
-                finish = "stop"
-                req.cancelled = True
-                break
+        try:
+            async for ev in self._events(req):
+                text, cut = matcher.push(ev["text"])
+                full += text
+                n_tokens += 1 if ev["token_id"] >= 0 else 0
+                finish = ev["finish_reason"]
+                if cut:
+                    finish = "stop"
+                    req.cancelled = True
+                    break
+        except asyncio.CancelledError:
+            req.cancelled = True  # client disconnected; stop decoding
+            raise
+        if finish != "stop":
+            full += matcher.flush()
         msg = ({"message": {"role": "assistant", "content": full}}
                if chat else {"text": full})
         return web.json_response({

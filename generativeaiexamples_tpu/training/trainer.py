@@ -1,0 +1,123 @@
+"""Sharded training: next-token SFT/pretraining step for the llama models.
+
+The reference ships fine-tuning only as NeMo notebooks (models/Gemma,
+models/StarCoder2 etc., SURVEY.md §2.1 "Model fine-tuning examples");
+here it's a first-class sharded train step over the same mesh/rule
+machinery as serving: data parallel over ("data","fsdp"), tensor
+parallel within layers, optional sequence sharding of activations.
+XLA inserts the gradient all-reduces from the shardings — no hand-rolled
+collectives (SURVEY.md §2.3 NCCL row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.parallel.mesh import (
+    logical_to_spec, LLM_RULES, spec_tree_to_shardings)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 2e-5
+    weight_decay: float = 0.0
+    warmup_steps: int = 100
+    grad_clip: float = 1.0
+    remat: bool = True  # rematerialize layer activations (HBM for FLOPs)
+
+
+def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, tcfg.learning_rate, tcfg.warmup_steps, 100_000)
+    return optax.chain(
+        optax.clip_by_global_norm(tcfg.grad_clip),
+        optax.adamw(sched, weight_decay=tcfg.weight_decay),
+    )
+
+
+def loss_fn(params, cfg: llama.LlamaConfig, tokens, targets, mask):
+    """Mean next-token cross-entropy over mask==1 positions."""
+    logits, _ = llama.forward(params, cfg, tokens, use_pallas=False)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(cfg: llama.LlamaConfig, tcfg: TrainConfig,
+                    optimizer: optax.GradientTransformation) -> Callable:
+    """Returns jit-able (params, opt_state, batch) -> (params, opt_state,
+    metrics). Batch: {tokens, targets, mask} each [B, S]."""
+
+    def step(params, opt_state, batch):
+        lf = loss_fn
+        if tcfg.remat:
+            lf = jax.checkpoint(loss_fn, static_argnums=(1,))
+        loss, grads = jax.value_and_grad(lf)(
+            params, cfg, batch["tokens"], batch["targets"], batch["mask"])
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    return step
+
+
+def shard_train_state(params, cfg: llama.LlamaConfig, optimizer, mesh,
+                      rules: dict = LLM_RULES):
+    """Place params + fresh opt state on the mesh with the model's specs
+    (adam moments shard exactly like their params)."""
+    specs = llama.param_specs(cfg, rules)
+    shardings = spec_tree_to_shardings(mesh, specs)
+    params = jax.tree.map(jax.device_put, params, shardings)
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=_opt_state_shardings(optimizer, params, shardings),
+    )(params)
+    return params, opt_state, specs
+
+
+def _opt_state_shardings(optimizer, params, param_shardings):
+    """Sharding tree for optimizer state: moment tensors inherit their
+    param's sharding; scalars replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shape = jax.eval_shape(optimizer.init, params)
+    # Robust across optax state pytree shapes: any state leaf shaped like
+    # a param inherits that param's sharding; scalars/others replicate.
+    flat_params = jax.tree_util.tree_flatten(params)[0]
+    flat_sh = jax.tree_util.tree_flatten(param_shardings)[0]
+    by_shape = {}
+    for p, s in zip(flat_params, flat_sh):
+        by_shape.setdefault((p.shape, p.dtype), s)
+    mesh = flat_sh[0].mesh if flat_sh else None
+    replicated = NamedSharding(mesh, PartitionSpec()) if mesh else None
+
+    def pick(leaf):
+        return by_shape.get((leaf.shape, leaf.dtype), replicated)
+
+    return jax.tree.map(pick, shape)
+
+
+def batch_specs(rules: dict = LLM_RULES):
+    s = logical_to_spec(("batch", "seq"), rules)
+    return {"tokens": s, "targets": s, "mask": s}
+
+
+def synthetic_batch(cfg: llama.LlamaConfig, batch: int, seq: int, seed: int = 0):
+    """Random LM batch for tests/benchmarks (targets = tokens shifted)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
+    return {
+        "tokens": jnp.asarray(toks[:, :-1]),
+        "targets": jnp.asarray(toks[:, 1:]),
+        "mask": jnp.ones((batch, seq), jnp.float32),
+    }

@@ -1,0 +1,201 @@
+"""Minimal pure-Python PDF text extraction.
+
+The reference leans on pdfplumber/PDFReader (developer_rag chains.py:
+76-84, multimodal custom_pdf_parser.py) — neither ships in this image,
+and ingestion must not depend on network installs. This extractor
+handles the common machine-generated PDF shape:
+
+- classic xref tables AND xref streams (PDF 1.5+), object streams
+- FlateDecode content streams (zlib)
+- text operators Tj / TJ / ' / " inside BT..ET, with () string escapes
+  and <> hex strings
+- page ordering via the page tree
+
+It does NOT do layout analysis, OCR, or encrypted PDFs — those degrade
+to empty text with a warning (the multimodal pipeline treats image/table
+extraction as pluggable; see pipelines.multimodal).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger(__name__)
+
+_OBJ_RE = re.compile(rb"(\d+)\s+(\d+)\s+obj")
+_STREAM_RE = re.compile(rb"stream\r?\n")
+
+
+class _PDF:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.objects: Dict[int, bytes] = {}
+        self._scan_objects()
+
+    def _scan_objects(self) -> None:
+        """Brute scan for `N G obj ... endobj` — robust to broken xrefs."""
+        for m in _OBJ_RE.finditer(self.data):
+            start = m.end()
+            end = self.data.find(b"endobj", start)
+            if end < 0:
+                continue
+            self.objects[int(m.group(1))] = self.data[start:end]
+        self._expand_object_streams()
+
+    def _expand_object_streams(self) -> None:
+        """Objects stored inside /Type/ObjStm compressed streams."""
+        for num in list(self.objects):
+            body = self.objects[num]
+            if b"/ObjStm" not in body:
+                continue
+            payload = self._stream_payload(body)
+            if payload is None:
+                continue
+            n = self._int_key(body, b"/N")
+            first = self._int_key(body, b"/First")
+            if n is None or first is None:
+                continue
+            header = payload[:first].split()
+            try:
+                pairs = [(int(header[i]), int(header[i + 1]))
+                         for i in range(0, 2 * n, 2)]
+            except (ValueError, IndexError):
+                continue
+            for i, (onum, off) in enumerate(pairs):
+                end = pairs[i + 1][1] if i + 1 < len(pairs) else len(payload) - first
+                self.objects.setdefault(onum, payload[first + off: first + end])
+
+    @staticmethod
+    def _int_key(body: bytes, key: bytes) -> Optional[int]:
+        m = re.search(re.escape(key) + rb"\s+(\d+)", body)
+        return int(m.group(1)) if m else None
+
+    def _stream_payload(self, body: bytes) -> Optional[bytes]:
+        m = _STREAM_RE.search(body)
+        if not m:
+            return None
+        raw = body[m.end():]
+        end = raw.rfind(b"endstream")
+        if end >= 0:
+            raw = raw[:end].rstrip(b"\r\n")
+        if b"/FlateDecode" in body[:m.start()]:
+            try:
+                return zlib.decompress(raw)
+            except zlib.error:
+                try:  # some writers pad; try raw deflate
+                    return zlib.decompressobj().decompress(raw)
+                except zlib.error:
+                    return None
+        return raw
+
+    # -- page tree ---------------------------------------------------------
+
+    def _ref(self, body: bytes, key: bytes) -> List[int]:
+        m = re.search(re.escape(key) + rb"\s*\[?((?:\s*\d+\s+\d+\s+R)+)", body)
+        if not m:
+            return []
+        return [int(x) for x in re.findall(rb"(\d+)\s+\d+\s+R", m.group(1))]
+
+    def page_content_streams(self) -> List[bytes]:
+        pages = [num for num, b in self.objects.items()
+                 if re.search(rb"/Type\s*/Page\b(?!s)", b)]
+        # order via the page tree when possible
+        ordered: List[int] = []
+        roots = [num for num, b in self.objects.items()
+                 if b.find(b"/Type") >= 0 and b.find(b"/Pages") >= 0
+                 and b.find(b"/Kids") >= 0]
+
+        def walk(num: int, seen) -> None:
+            if num in seen:
+                return
+            seen.add(num)
+            body = self.objects.get(num, b"")
+            if re.search(rb"/Type\s*/Page\b(?!s)", body):
+                ordered.append(num)
+                return
+            for kid in self._ref(body, b"/Kids"):
+                walk(kid, seen)
+
+        seen: set = set()
+        for r in roots:
+            walk(r, seen)
+        page_nums = ordered or sorted(pages)
+        streams = []
+        for p in page_nums:
+            body = self.objects.get(p, b"")
+            for c in self._ref(body, b"/Contents"):
+                cbody = self.objects.get(c)
+                if cbody is None:
+                    continue
+                payload = self._stream_payload(cbody)
+                if payload:
+                    streams.append(payload)
+        return streams
+
+
+_TEXT_OP = re.compile(
+    rb"\((?P<str>(?:\\.|[^\\()])*)\)\s*(?:Tj|')|"
+    rb"\[(?P<arr>(?:\\.|[^\]])*)\]\s*TJ|"
+    rb"<(?P<hex>[0-9A-Fa-f\s]+)>\s*Tj", re.S)
+_ESC = {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"b": b"\b", b"f": b"\f",
+        b"(": b"(", b")": b")", b"\\": b"\\"}
+
+
+def _unescape(s: bytes) -> bytes:
+    out = bytearray()
+    i = 0
+    while i < len(s):
+        c = s[i:i + 1]
+        if c == b"\\" and i + 1 < len(s):
+            nxt = s[i + 1:i + 2]
+            if nxt.isdigit():  # octal escape
+                j = i + 1
+                while j < min(i + 4, len(s)) and s[j:j + 1].isdigit():
+                    j += 1
+                out.append(int(s[i + 1:j], 8) & 0xFF)
+                i = j
+                continue
+            out += _ESC.get(nxt, nxt)
+            i += 2
+            continue
+        out += c
+        i += 1
+    return bytes(out)
+
+
+def _stream_text(payload: bytes) -> str:
+    parts: List[str] = []
+    for m in _TEXT_OP.finditer(payload):
+        if m.group("str") is not None:
+            parts.append(_unescape(m.group("str")).decode("latin-1"))
+        elif m.group("arr") is not None:
+            for sm in re.finditer(rb"\((?:\\.|[^\\()])*\)", m.group("arr")):
+                parts.append(_unescape(sm.group(0)[1:-1]).decode("latin-1"))
+        elif m.group("hex") is not None:
+            hx = re.sub(rb"\s", b"", m.group("hex"))
+            try:
+                raw = bytes.fromhex(hx.decode())
+                # UTF-16BE if BOM, else latin-1
+                parts.append(raw.decode("utf-16-be") if raw[:2] == b"\xfe\xff"
+                             else raw.decode("latin-1"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+    text = "".join(parts)
+    return text
+
+
+def extract_text(path: str) -> str:
+    """Whole-document text, pages separated by form feeds."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if not data.startswith(b"%PDF"):
+        raise ValueError(f"{path} is not a PDF")
+    if b"/Encrypt" in data[:4096] or b"/Encrypt" in data[-4096:]:
+        _LOG.warning("%s is encrypted; cannot extract text", path)
+        return ""
+    pdf = _PDF(data)
+    pages = [_stream_text(s) for s in pdf.page_content_streams()]
+    return "\f".join(p for p in pages if p.strip())

@@ -24,6 +24,7 @@ class ByteTokenizer:
         self.pad_id = self.specials.get("<pad>", 256)
         self.bos_id = self.specials.get("<bos>", 257)
         self.eos_id = self.specials.get("<eos>", 258)
+        self.eos_ids = {self.eos_id}
         self.vocab_size = 256 + len(specials)
 
     def encode(self, text: str, add_bos: bool = False) -> List[int]:
@@ -55,6 +56,11 @@ class HFTokenizer:
         self.vocab_size = self.tk.get_vocab_size()
         self.bos_id = self._first_id(("<|begin_of_text|>", "<s>", "<bos>"))
         self.eos_id = self._first_id(self.LLAMA3_EOS + ("</s>", "<eos>"))
+        # ALL eos variants terminate generation (llama3 emits either
+        # <|eot_id|> or <|end_of_text|> depending on context)
+        self.eos_ids = {i for i in (
+            self.tk.token_to_id(n) for n in
+            self.LLAMA3_EOS + ("</s>", "<eos>")) if i is not None}
         self.pad_id = self._first_id(("<pad>", "<|finetune_right_pad_id|>")) or 0
         # BERT-style specials (embedder/reranker tokenizers)
         self.cls_id = self._first_id(("[CLS]",))

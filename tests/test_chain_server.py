@@ -1,0 +1,158 @@
+"""Chain server REST contract, hermetic (fake LLM/embedder), matching the
+reference's openapi_schema.json field-for-field."""
+
+import asyncio
+import io
+import json
+
+import pytest
+
+from generativeaiexamples_tpu.api.server import ChainServer, sanitize
+from generativeaiexamples_tpu.config.schema import AppConfig
+from generativeaiexamples_tpu.config.wizard import load_config
+from generativeaiexamples_tpu.connectors.fakes import EchoLLM, HashEmbedder
+from generativeaiexamples_tpu.pipelines.base import get_example_class
+from generativeaiexamples_tpu.pipelines.resources import Resources
+
+
+def _make_server(tmp_path, example="developer_rag", script=None):
+    cfg = load_config(path="", env={})
+    res = Resources(cfg, llm=EchoLLM(script=script),
+                    embedder=HashEmbedder(64), reranker=None)
+    ex = get_example_class(example)(res)
+    return ChainServer(cfg, example=ex, upload_dir=str(tmp_path / "up"))
+
+
+def _call(server, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def _sse_frames(raw: str):
+    return [json.loads(ln[6:]) for ln in raw.splitlines()
+            if ln.startswith("data: ")]
+
+
+def test_generate_llm_chain_sse_contract(tmp_path):
+    srv = _make_server(tmp_path)
+
+    async def body(c):
+        r = await c.post("/generate", json={
+            "messages": [{"role": "user", "content": "hello chain"}],
+            "use_knowledge_base": False, "max_tokens": 64})
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        return (await r.read()).decode()
+
+    frames = _sse_frames(_call(srv, body))
+    assert frames[-1]["choices"][0]["finish_reason"] == "[DONE]"
+    text = "".join(f["choices"][0]["message"]["content"] for f in frames)
+    assert "hello chain" in text  # EchoLLM echoes the query
+    assert all(f["choices"][0]["message"]["role"] == "assistant"
+               for f in frames)
+    assert all("id" in f for f in frames)
+
+
+def test_upload_list_search_generate_delete_roundtrip(tmp_path):
+    srv = _make_server(tmp_path)
+    doc = ("TPU v5e chips have 16 GB HBM memory.\n\n"
+           "The MXU systolic array multiplies matrices.\n\n" * 3)
+
+    async def body(c):
+        import aiohttp
+
+        form = aiohttp.FormData()
+        form.add_field("file", io.BytesIO(doc.encode()),
+                       filename="tpu_facts.txt")
+        r1 = await c.post("/documents", data=form)
+        assert r1.status == 200, await r1.text()
+        r2 = await (await c.get("/documents")).json()
+        r3 = await (await c.post("/search", json={
+            "query": "HBM memory", "top_k": 2})).json()
+        r4 = await c.post("/generate", json={
+            "messages": [{"role": "user", "content": "How much HBM memory?"}],
+            "use_knowledge_base": True})
+        raw = (await r4.read()).decode()
+        r5 = await c.delete("/documents?filename=tpu_facts.txt")
+        r6 = await (await c.get("/documents")).json()
+        return r2, r3, raw, r5.status, r6
+
+    docs, search, gen_raw, del_status, docs_after = _call(srv, body)
+    assert docs["documents"] == ["tpu_facts.txt"]
+    assert search["chunks"] and search["chunks"][0]["filename"] == "tpu_facts.txt"
+    assert {"content", "filename", "score"} <= set(search["chunks"][0])
+    frames = _sse_frames(gen_raw)
+    assert frames[-1]["choices"][0]["finish_reason"] == "[DONE]"
+    assert del_status == 200
+    assert docs_after["documents"] == []
+
+
+def test_generate_empty_kb_short_circuits(tmp_path):
+    srv = _make_server(tmp_path)
+
+    async def body(c):
+        r = await c.post("/generate", json={
+            "messages": [{"role": "user", "content": "anything"}],
+            "use_knowledge_base": True})
+        return (await r.read()).decode()
+
+    frames = _sse_frames(_call(srv, body))
+    text = "".join(f["choices"][0]["message"]["content"] for f in frames)
+    assert "No response generated" in text
+
+
+def test_generate_error_streams_apology(tmp_path):
+    srv = _make_server(tmp_path)
+
+    class Boom:
+        def stream_chat(self, *a, **k):
+            raise RuntimeError("kaput")
+        chat = stream_chat
+
+    srv.example.res.llm = Boom()
+
+    async def body(c):
+        r = await c.post("/generate", json={
+            "messages": [{"role": "user", "content": "x"}],
+            "use_knowledge_base": False})
+        return (await r.read()).decode()
+
+    frames = _sse_frames(_call(srv, body))
+    text = "".join(f["choices"][0]["message"]["content"] for f in frames)
+    assert "Error from chain server" in text
+    assert frames[-1]["choices"][0]["finish_reason"] == "[DONE]"
+
+
+def test_validation_errors(tmp_path):
+    srv = _make_server(tmp_path)
+
+    async def body(c):
+        r1 = await c.post("/generate", json={"messages": []})
+        r2 = await c.delete("/documents")
+        r3 = await c.post("/generate", data=b"not json")
+        return r1.status, r2.status, r3.status
+
+    assert _call(srv, body) == (422, 422, 422)
+
+
+def test_sanitize_strips_html_and_ctrl():
+    assert sanitize("<script>x\x00\x01</script>") == \
+        "&lt;script&gt;x&lt;/script&gt;"
+    assert len(sanitize("a" * 200000)) == 131072
+
+
+def test_health(tmp_path):
+    srv = _make_server(tmp_path)
+
+    async def body(c):
+        return await (await c.get("/health")).json()
+
+    assert _call(srv, body) == {"message": "Service is up."}

@@ -1,0 +1,173 @@
+"""RAG layer: splitters, vector stores, retriever, documents, PDF, fakes."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.connectors.fakes import (
+    EchoLLM, HashEmbedder, OverlapReranker)
+from generativeaiexamples_tpu.rag.documents import load_document
+from generativeaiexamples_tpu.rag.retriever import BM25Lexical, Retriever
+from generativeaiexamples_tpu.rag.splitter import (
+    RecursiveCharacterSplitter, TokenTextSplitter)
+from generativeaiexamples_tpu.rag.vectorstore import (
+    MemoryVectorStore, TPUVectorStore)
+
+DOCS = [
+    ("tpus.txt", "TPUs are matrix multiplication accelerators built by "
+                 "Google. The MXU is a systolic array."),
+    ("tpus.txt", "TPU v5e has 16 GB of HBM per chip and fast ICI links."),
+    ("fruit.txt", "Bananas are yellow and rich in potassium."),
+    ("fruit.txt", "Apples can be red, green, or yellow."),
+]
+
+
+def _store(cls=MemoryVectorStore):
+    emb = HashEmbedder(dim=64)
+    store = cls(64)
+    texts = [t for _, t in DOCS]
+    store.add(texts, emb.embed_documents(texts),
+              [{"filename": f} for f, _ in DOCS])
+    return store, emb
+
+
+class TestSplitters:
+    def test_token_splitter_chunks_and_overlap(self):
+        sp = TokenTextSplitter(chunk_size=10, chunk_overlap=4)
+        text = " ".join(f"w{i}" for i in range(30))
+        chunks = sp.split(text)
+        assert all(sp.count(c) <= 10 for c in chunks)
+        # overlap: consecutive chunks share tokens
+        assert chunks[0].split()[-1] in chunks[1].split()
+        joined = " ".join(chunks)
+        assert all(f"w{i}" in joined for i in range(30))
+
+    def test_recursive_splitter_respects_paragraphs(self):
+        sp = RecursiveCharacterSplitter(chunk_size=50, chunk_overlap=0)
+        text = "para one is here.\n\npara two is here.\n\npara three is long "
+        chunks = sp.split(text)
+        assert all(len(c) <= 50 for c in chunks)
+        assert any("para one" in c for c in chunks)
+
+    def test_bad_overlap_raises(self):
+        with pytest.raises(ValueError):
+            TokenTextSplitter(chunk_size=10, chunk_overlap=10)
+
+
+class TestVectorStore:
+    @pytest.mark.parametrize("cls", [MemoryVectorStore, TPUVectorStore])
+    def test_search_relevance(self, cls):
+        store, emb = _store(cls)
+        res = store.search(emb.embed_query("TPU HBM chip"), top_k=2)
+        assert len(res) == 2
+        assert "HBM" in res[0].text  # exact word-overlap winner first
+
+    def test_delete_by_filename(self):
+        store, emb = _store()
+        assert store.list_documents() == ["fruit.txt", "tpus.txt"]
+        removed = store.delete_documents(["tpus.txt"])
+        assert removed == 2 and len(store) == 2
+        res = store.search(emb.embed_query("TPU"), top_k=4)
+        assert all("TPU" not in r.text for r in res)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        store, emb = _store()
+        store.save(str(tmp_path))
+        loaded = MemoryVectorStore.load(str(tmp_path), dim=64)
+        assert len(loaded) == len(store)
+        a = store.search(emb.embed_query("banana"), top_k=1)[0]
+        b = loaded.search(emb.embed_query("banana"), top_k=1)[0]
+        assert a.text == b.text
+
+    def test_tpu_store_matches_memory_store(self):
+        m, emb = _store(MemoryVectorStore)
+        t, _ = _store(TPUVectorStore)
+        # distinct scores per doc (equal scores tie-break differently
+        # between numpy argpartition and jax top_k, which is fine)
+        q = emb.embed_query("bananas rich in potassium are yellow")
+        rm = m.search(q, top_k=3)
+        rt = t.search(q, top_k=3)
+        assert [r.text for r in rm] == [r.text for r in rt]
+        np.testing.assert_allclose([r.score for r in rm],
+                                   [r.score for r in rt], atol=1e-5)
+
+
+class TestRetriever:
+    def test_threshold_fallback(self):
+        store, emb = _store()
+        r = Retriever(store, emb, top_k=2, score_threshold=0.99)
+        res = r.retrieve("completely unrelated nonsense zzz")
+        assert len(res) > 0  # fell back to no-threshold retrieval
+
+    def test_token_budget_truncates(self):
+        store, emb = _store()
+        r = Retriever(store, emb, top_k=4, max_context_tokens=12)
+        res = r.limit_tokens(r.retrieve("TPU", with_threshold=False))
+        total = sum(len(r2.text.split()) for r2 in res)
+        assert total <= 20  # approx tokens cap
+
+    def test_hybrid_with_reranker(self):
+        store, emb = _store()
+        r = Retriever(store, emb, top_k=2, reranker=OverlapReranker())
+        res = r.retrieve_hybrid("systolic array MXU")
+        assert res and "systolic" in res[0].text
+
+    def test_bm25_ranks_exact_terms(self):
+        bm = BM25Lexical()
+        bm.fit([t for _, t in DOCS])
+        s = bm.scores("potassium")
+        assert int(np.argmax(s)) == 2
+
+
+class TestDocuments:
+    def test_text_and_html(self, tmp_path):
+        p = tmp_path / "a.md"
+        p.write_text("# Title\nbody text")
+        docs = load_document(str(p))
+        assert docs[0].text.startswith("# Title")
+        h = tmp_path / "b.html"
+        h.write_text("<html><script>x=1</script><body><p>hello</p></body></html>")
+        docs = load_document(str(h))
+        assert "hello" in docs[0].text and "x=1" not in docs[0].text
+
+    def test_pdf_extraction(self, tmp_path):
+        # hand-built minimal PDF with a FlateDecode content stream
+        content = zlib.compress(
+            b"BT /F1 12 Tf 72 720 Td (Hello TPU world) Tj ET\n"
+            b"BT [(And) -250 ( more text)] TJ ET")
+        pdf = (b"%PDF-1.4\n"
+               b"1 0 obj\n<< /Type /Catalog /Pages 2 0 R >>\nendobj\n"
+               b"2 0 obj\n<< /Type /Pages /Kids [3 0 R] /Count 1 >>\nendobj\n"
+               b"3 0 obj\n<< /Type /Page /Parent 2 0 R /Contents 4 0 R >>\nendobj\n"
+               b"4 0 obj\n<< /Length " + str(len(content)).encode() +
+               b" /Filter /FlateDecode >>\nstream\n" + content +
+               b"\nendstream\nendobj\n"
+               b"trailer\n<< /Root 1 0 R >>\n%%EOF")
+        p = tmp_path / "t.pdf"
+        p.write_bytes(pdf)
+        docs = load_document(str(p))
+        assert docs and "Hello TPU world" in docs[0].text
+        assert "And more text" in docs[0].text.replace("  ", " ")
+
+    def test_unsupported_type_skipped(self, tmp_path):
+        p = tmp_path / "x.bin"
+        p.write_bytes(b"\x00\x01")
+        assert load_document(str(p)) == []
+
+
+class TestFakes:
+    def test_echo_llm_scripted(self):
+        llm = EchoLLM(script=[("weather", "It is sunny.")])
+        out = llm.chat([{"role": "user", "content": "what's the weather?"}])
+        assert out == "It is sunny."
+        out2 = llm.chat([{"role": "user", "content": "hi"}])
+        assert out2.startswith("ECHO:")
+
+    def test_hash_embedder_geometry(self):
+        e = HashEmbedder(32)
+        a = e.embed_query("the tpu chip")
+        b = e.embed_query("tpu chip design")
+        c = e.embed_query("banana smoothie recipe")
+        assert a @ b > a @ c

@@ -48,3 +48,10 @@ def test_detokenizer_holds_incomplete_utf8():
     pieces = [d.push(i) for i in ids]
     assert "".join(pieces) == "héllo"
     assert "�" not in "".join(pieces)
+
+
+def test_stop_prefix_at_end_is_flushed():
+    m = StopStream(["END"])
+    text, hit = m.push("bye E")  # "E" held back as possible stop prefix
+    assert (text, hit) == ("bye ", False)
+    assert m.flush() == "E"  # natural finish releases it
