@@ -122,6 +122,19 @@ class PromptsConfig:
 
 
 @dataclass(frozen=True)
+class VLMConfig:
+    """Vision-language model endpoint for multimodal ingestion (the
+    reference calls Neva-22b for chart detection and DePlot for chart->
+    table; multimodal_rag/vectorstore/custom_pdf_parser.py:42-70). Remote
+    OpenAI-compatible endpoint; empty server_url disables image/chart
+    enrichment (ingestion degrades to text-only)."""
+
+    server_url: str = ""
+    model_name: str = "neva-22b"
+    deplot_model_name: str = "google/deplot"
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout — the TPU-native replacement for the reference's
     single multi-GPU knob (INFERENCE_GPU_COUNT, compose.env:17-18).
@@ -179,6 +192,7 @@ class AppConfig:
     embeddings: EmbeddingConfig = field(default_factory=EmbeddingConfig)
     reranker: RerankerConfig = field(default_factory=RerankerConfig)
     retriever: RetrieverConfig = field(default_factory=RetrieverConfig)
+    vlm: VLMConfig = field(default_factory=VLMConfig)
     prompts: PromptsConfig = field(default_factory=PromptsConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)

@@ -1,0 +1,66 @@
+"""Vision-language connector: OpenAI-compatible multimodal chat.
+
+The reference's multimodal pipeline calls Neva-22b to classify images as
+charts (`is_graph`, custom_pdf_parser.py:43) and DePlot to linearize
+charts into tables (`process_graph` :55-70). Both ride the same
+image+text chat API shape, so one client covers them. No TPU VLM exists
+in this framework yet; the connector keeps the capability pluggable
+against any endpoint (and tests inject fakes).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+import requests
+
+
+class VLMClient:
+    def __init__(self, base_url: str, model: str = "", api_key: str = "",
+                 timeout: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.timeout = timeout
+        self.session = requests.Session()
+        if api_key:
+            self.session.headers["Authorization"] = f"Bearer {api_key}"
+
+    def describe(self, image_bytes: bytes, prompt: str,
+                 image_format: str = "jpeg", max_tokens: int = 512) -> str:
+        b64 = base64.b64encode(image_bytes).decode()
+        body = {
+            "model": self.model,
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": prompt},
+                {"type": "image_url", "image_url": {
+                    "url": f"data:image/{image_format};base64,{b64}"}},
+            ]}],
+            "max_tokens": max_tokens,
+        }
+        r = self.session.post(f"{self.base_url}/chat/completions", json=body,
+                              timeout=self.timeout)
+        r.raise_for_status()
+        return r.json()["choices"][0]["message"]["content"]
+
+    def is_chart(self, image_bytes: bytes, image_format: str = "jpeg") -> bool:
+        """Neva-role: is this a chart/plot? (is_graph parity)."""
+        out = self.describe(
+            image_bytes,
+            "Is this image a chart, graph or plot? Answer yes or no only.",
+            image_format, max_tokens=8)
+        return "yes" in out.lower()
+
+    def chart_to_table(self, image_bytes: bytes,
+                       image_format: str = "jpeg") -> str:
+        """DePlot-role: linearize a chart into a data table."""
+        return self.describe(
+            image_bytes,
+            "Generate the underlying data table for this chart.",
+            image_format)
+
+
+def make_vlm(config) -> Optional[VLMClient]:
+    if not config.vlm.server_url:
+        return None
+    return VLMClient(config.vlm.server_url, model=config.vlm.model_name)

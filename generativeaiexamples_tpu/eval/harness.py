@@ -1,0 +1,134 @@
+"""Eval harness: synthetic QA generation + answer generation vs a chain
+server + metric reports.
+
+Mirrors the reference's tools/evaluation flow (SURVEY.md §3.6):
+ 01 synthetic QA gen from chunks (synthetic_data_generator/data_generator.py)
+ 02 answer generation via chain-server REST (/documents + /generate)
+    (llm_answer_generator.py)
+ 03 RAGAS metrics   04 LLM judge      (rag_evaluator/evaluator.py)
+Dataset rows share the reference's JSON schema so existing datasets and
+result files interchange.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+import requests
+
+_LOG = logging.getLogger(__name__)
+
+_QA_PROMPT = """\
+Generate one question-answer pair about the following passage. The
+question must be answerable from the passage alone.
+
+Passage:
+{chunk}
+
+Reply with one JSON object: {{"question": "...", "answer": "..."}}"""
+
+
+def generate_synthetic_qa(llm, chunks: Sequence[str],
+                          n_pairs: Optional[int] = None) -> List[Dict]:
+    """Chunks -> [{question, ground_truth_answer, ground_truth_context}]."""
+    out = []
+    for chunk in chunks[: n_pairs or len(chunks)]:
+        reply = llm.chat([{"role": "user",
+                           "content": _QA_PROMPT.format(chunk=chunk)}],
+                         max_tokens=256, temperature=0.0)
+        m = re.search(r"\{.*\}", reply, re.S)
+        if not m:
+            continue
+        try:
+            obj = json.loads(m.group(0))
+            out.append({
+                "question": str(obj["question"]),
+                "ground_truth_answer": str(obj["answer"]),
+                "ground_truth_context": chunk,
+            })
+        except (json.JSONDecodeError, KeyError):
+            _LOG.info("unparseable QA pair; skipping")
+    return out
+
+
+class ChainServerClient:
+    """Minimal REST client for the chain server (answer generation
+    harness; llm_answer_generator.py parity)."""
+
+    def __init__(self, base_url: str = "http://localhost:8081",
+                 timeout: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def upload(self, path: str) -> None:
+        with open(path, "rb") as fh:
+            r = requests.post(f"{self.base_url}/documents",
+                              files={"file": (os.path.basename(path), fh)},
+                              timeout=self.timeout)
+        r.raise_for_status()
+
+    def search(self, query: str, top_k: int = 4) -> List[Dict]:
+        r = requests.post(f"{self.base_url}/search",
+                          json={"query": query, "top_k": top_k},
+                          timeout=self.timeout)
+        r.raise_for_status()
+        return r.json().get("chunks", [])
+
+    def generate(self, question: str, use_kb: bool = True,
+                 **settings) -> str:
+        body = {"messages": [{"role": "user", "content": question}],
+                "use_knowledge_base": use_kb, **settings}
+        r = requests.post(f"{self.base_url}/generate", json=body,
+                          stream=True, timeout=self.timeout)
+        r.raise_for_status()
+        pieces = []
+        for line in r.iter_lines():
+            line = line.decode() if isinstance(line, bytes) else line
+            if not line.startswith("data: "):
+                continue
+            try:
+                frame = json.loads(line[6:])
+            except json.JSONDecodeError:
+                continue
+            choice = frame["choices"][0]
+            if choice.get("finish_reason") == "[DONE]":
+                break
+            pieces.append(choice["message"]["content"])
+        return "".join(pieces)
+
+
+def generate_answers(client: ChainServerClient, qa_rows: Sequence[Dict],
+                     top_k: int = 4) -> List[Dict]:
+    """02: query the server per question, capture answer + retrieved
+    context (llm_answer_generator.py output schema)."""
+    out = []
+    for row in qa_rows:
+        chunks = client.search(row["question"], top_k=top_k)
+        answer = client.generate(row["question"], use_kb=True)
+        out.append({
+            **row,
+            "generated_answer": answer,
+            "retrieved_context": [c["content"] for c in chunks],
+        })
+    return out
+
+
+def run_eval(llm, embedder, dataset: Sequence[Dict],
+             judge_llm=None) -> Dict:
+    """03+04: metric suite + judge; returns the combined report."""
+    from generativeaiexamples_tpu.eval.metrics import (
+        RagasEvaluator, eval_llm_judge)
+
+    ragas = RagasEvaluator(llm, embedder).evaluate(dataset)
+    judge = eval_llm_judge(judge_llm or llm, dataset)
+    return {"ragas": ragas, "llm_judge": judge, "n": len(dataset)}
+
+
+def save_report(report: Dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2)

@@ -1,0 +1,141 @@
+"""OpenTelemetry tracing: spans across frontend -> chain server -> engine.
+
+Parity with the reference's tracing glue (common/tracing.py +
+tools/observability/*/opentelemetry_callback.py): W3C traceparent
+propagation over HTTP, spans for generate/retrieve/llm with token
+counts, TTFT event on first token (the reference hooks
+on_llm_new_token, opentelemetry_callback.py:248). Toggled by
+tracing.enabled / ENABLE_TRACING; everything no-ops cleanly when the
+otel SDK is absent or disabled (same import-guard posture as the
+reference, utils.py:26-87).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Dict, Iterator, Optional
+
+_LOG = logging.getLogger(__name__)
+
+_TRACER = None
+_ENABLED = False
+
+
+def setup(config=None) -> bool:
+    """Initialize the tracer once per process. Returns enabled state."""
+    global _TRACER, _ENABLED
+    enabled = (os.environ.get("ENABLE_TRACING", "").lower() in ("1", "true")
+               or (config is not None and config.tracing.enabled))
+    if not enabled:
+        _ENABLED = False
+        return False
+    try:
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import (
+            BatchSpanProcessor, ConsoleSpanExporter)
+
+        service = (config.tracing.service_name if config else "chain-server")
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": service}))
+        exporter = None
+        endpoint = (config.tracing.otlp_endpoint if config
+                    else os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", ""))
+        if endpoint:
+            try:
+                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter \
+                    import OTLPSpanExporter
+
+                exporter = OTLPSpanExporter(endpoint=endpoint, insecure=True)
+            except Exception:
+                _LOG.warning("OTLP exporter unavailable; using console")
+        provider.add_span_processor(
+            BatchSpanProcessor(exporter or ConsoleSpanExporter()))
+        trace.set_tracer_provider(provider)
+        _TRACER = trace.get_tracer("generativeaiexamples_tpu")
+        _ENABLED = True
+        return True
+    except Exception:
+        _LOG.exception("tracing setup failed; disabled")
+        _ENABLED = False
+        return False
+
+
+def extract_context(headers: Dict[str, str]):
+    """W3C traceparent from incoming HTTP headers (reference
+    tracing.py:62-73)."""
+    if not _ENABLED:
+        return None
+    try:
+        from opentelemetry.propagate import extract
+
+        return extract(dict(headers))
+    except Exception:
+        return None
+
+
+def inject_context(headers: Dict[str, str]) -> Dict[str, str]:
+    """Inject the current span context into outgoing headers (reference
+    frontend/tracing.py:46-50)."""
+    if _ENABLED:
+        try:
+            from opentelemetry.propagate import inject
+
+            inject(headers)
+        except Exception:
+            pass
+    return headers
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[Dict] = None,
+         context=None) -> Iterator:
+    """Span context manager that degrades to a timing log span."""
+    if _ENABLED and _TRACER is not None:
+        with _TRACER.start_as_current_span(name, context=context) as sp:
+            for k, v in (attributes or {}).items():
+                sp.set_attribute(k, v)
+            yield sp
+    else:
+        yield _NullSpan()
+
+
+class _NullSpan:
+    def set_attribute(self, *a, **k):
+        pass
+
+    def add_event(self, *a, **k):
+        pass
+
+
+class GenerationSpan:
+    """Per-request span helper: records TTFT as an event on the first
+    token and token counts at the end."""
+
+    def __init__(self, name: str = "generate", context=None):
+        self._cm = span(name, context=context)
+        self.sp = None
+        self.t0 = time.perf_counter()
+        self.first: Optional[float] = None
+        self.tokens = 0
+
+    def __enter__(self):
+        self.sp = self._cm.__enter__()
+        return self
+
+    def on_token(self):
+        if self.first is None:
+            self.first = time.perf_counter() - self.t0
+            self.sp.add_event("first_token",
+                              {"ttft_ms": round(self.first * 1e3, 2)})
+        self.tokens += 1
+
+    def __exit__(self, *exc):
+        self.sp.set_attribute("tokens_generated", self.tokens)
+        if self.first is not None:
+            self.sp.set_attribute("ttft_ms", round(self.first * 1e3, 2))
+        return self._cm.__exit__(*exc)

@@ -3,4 +3,6 @@
 Importing this package registers the built-in examples.
 """
 
-from generativeaiexamples_tpu.pipelines import developer_rag  # noqa: F401
+from generativeaiexamples_tpu.pipelines import (  # noqa: F401
+    api_catalog, developer_rag, multi_turn_rag, multimodal,
+    query_decomposition, structured_data)

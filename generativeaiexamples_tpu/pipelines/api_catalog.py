@@ -1,0 +1,31 @@
+"""Plain chat-RAG pipeline (reference: examples/nvidia_api_catalog/
+chains.py — LangChain against API-catalog endpoints).
+
+Distinctive behavior vs developer_rag: context is stuffed into the USER
+message rather than the system prompt (chains.py:129-141), chat history
+rides along, and retrieval falls back to thresholdless search
+(chains.py:120-127).
+"""
+
+from __future__ import annotations
+
+from typing import Generator
+
+from generativeaiexamples_tpu.pipelines.base import register_example
+from generativeaiexamples_tpu.pipelines.developer_rag import QAChatbot
+
+
+@register_example("api_catalog")
+class APICatalogChat(QAChatbot):
+    def rag_chain(self, query: str, chat_history, **llm_settings
+                  ) -> Generator[str, None, None]:
+        results = self.res.retriever.retrieve(query)
+        results = self.res.retriever.limit_tokens(results)
+        context = "\n\n".join(r.text for r in results)
+        system = self.res.config.prompts.chat_template
+        user = (f"Answer the question using the context below.\n\n"
+                f"Context:\n{context}\n\nQuestion: {query}" if context
+                else query)
+        messages = ([{"role": "system", "content": system}]
+                    + list(chat_history) + [{"role": "user", "content": user}])
+        yield from self.res.llm.stream_chat(messages, **llm_settings)

@@ -1,0 +1,125 @@
+"""Multimodal document pipeline (reference: examples/multimodal_rag —
+pdfplumber layout + OCR + Neva chart detection + DePlot chart->table,
+~1000 LoC across chains.py / custom_pdf_parser.py / vectorstore_updater).
+
+Structure kept, engines swapped for what this environment provides:
+- text: utils.pdf pure-Python extractor (pdfplumber role)
+- tables: whitespace-column heuristic over text lines (layout role)
+- images: embedded JPEG extraction; each image runs through the VLM
+  connector when configured — chart? -> chart_to_table (DePlot role),
+  else a description (Neva role). No VLM -> images are skipped, text and
+  tables still ingest (graceful degradation, reference behavior when its
+  VLM endpoints are down).
+- chunks carry a `content_type` tag ({text|table|image}) like the
+  reference's Milvus schema (retriever/vector.py:45-80), surfaced in the
+  RAG context header.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, Generator, List, Tuple
+
+from generativeaiexamples_tpu.pipelines.base import register_example
+from generativeaiexamples_tpu.pipelines.developer_rag import QAChatbot
+from generativeaiexamples_tpu.rag.splitter import RecursiveCharacterSplitter
+
+_LOG = logging.getLogger(__name__)
+
+_TABLE_ROW = re.compile(r"\S+(?:\s{2,}\S+){2,}")  # >=3 columns
+
+
+def find_tables(text: str) -> List[str]:
+    """Consecutive multi-column lines -> table blocks."""
+    tables, cur = [], []
+    for line in text.splitlines():
+        if _TABLE_ROW.fullmatch(line.strip()):
+            cur.append(line.rstrip())
+        else:
+            if len(cur) >= 3:
+                tables.append("\n".join(cur))
+            cur = []
+    if len(cur) >= 3:
+        tables.append("\n".join(cur))
+    return tables
+
+
+@register_example("multimodal")
+class MultimodalRAG(QAChatbot):
+    def _vlm(self):
+        if "vlm" not in self.res.extras:
+            from generativeaiexamples_tpu.connectors.vlm import make_vlm
+
+            self.res.extras["vlm"] = make_vlm(self.res.config)
+        return self.res.extras["vlm"]
+
+    def ingest_docs(self, filepath: str, filename: str) -> None:
+        from generativeaiexamples_tpu.rag.documents import load_document
+
+        chunks: List[str] = []
+        metas: List[Dict] = []
+        splitter = RecursiveCharacterSplitter(1000, 100)  # multimodal split
+        docs = load_document(filepath, filename)
+        full_text = "\n".join(d.text for d in docs)
+        for c in splitter.split(full_text):
+            chunks.append(c)
+            metas.append({"filename": filename, "content_type": "text"})
+        for t in find_tables(full_text):
+            chunks.append(t)
+            metas.append({"filename": filename, "content_type": "table"})
+        if filepath.lower().endswith(".pdf"):
+            self._ingest_pdf_images(filepath, filename, chunks, metas)
+        if not chunks:
+            raise ValueError(f"no extractable content in {filename}")
+        embs = self.res.embedder.embed_documents(chunks)
+        self.res.store.add(chunks, embs, metas)
+        _LOG.info("multimodal ingested %s: %d chunks (%d tables, %d images)",
+                  filename, len(chunks),
+                  sum(m["content_type"] == "table" for m in metas),
+                  sum(m["content_type"] == "image" for m in metas))
+
+    def _ingest_pdf_images(self, filepath: str, filename: str,
+                           chunks: List[str], metas: List[Dict]) -> None:
+        from generativeaiexamples_tpu.utils.pdf import extract_images
+
+        vlm = self._vlm()
+        images = extract_images(filepath)
+        if images and vlm is None:
+            _LOG.warning("%s has %d images but no VLM endpoint configured "
+                         "(vlm.server_url); skipping image enrichment",
+                         filename, len(images))
+            return
+        for i, (fmt, data) in enumerate(images):
+            try:
+                if vlm.is_chart(data, fmt):  # DePlot path
+                    desc = ("Chart data table:\n"
+                            + vlm.chart_to_table(data, fmt))
+                else:  # description path
+                    desc = vlm.describe(
+                        data, "Describe this image in detail.", fmt)
+            except Exception:
+                _LOG.exception("VLM enrichment failed for image %d of %s",
+                               i, filename)
+                continue
+            chunks.append(desc)
+            metas.append({"filename": filename, "content_type": "image",
+                          "image_index": i})
+
+    def rag_chain(self, query: str, chat_history, **llm_settings
+                  ) -> Generator[str, None, None]:
+        results = self.res.retriever.retrieve(query)
+        if not results:
+            yield ("No response generated from LLM, make sure your query is "
+                   "relevant to the ingested document.")
+            return
+        results = self.res.retriever.limit_tokens(results)
+        parts = []
+        for r in results:
+            tag = r.metadata.get("content_type", "text")
+            parts.append(f"[{tag}] {r.text}")
+        system = self.res.config.prompts.rag_template.format(
+            context="\n\n".join(parts))
+        messages = [{"role": "system", "content": system},
+                    {"role": "user", "content": query}]
+        yield from self.res.llm.stream_chat(messages, **llm_settings)

@@ -1,0 +1,164 @@
+"""CSV analytics pipeline (reference: examples/structured_data_rag/
+chains.py + csv_utils.py, PandasAI-backed).
+
+Parity behaviors:
+- ingest: CSVs register into a file list; new files must be column-
+  compatible with what's registered (chains.py:107-133).
+- rag_chain: an LLM writes a pandas expression against the dataframe
+  (the PandasAI Agent.chat role, chains.py:159-230), the result is
+  validated (is_result_valid parity, csv_utils.py:102), and a second
+  LLM phrases the final answer (the "response chain").
+- prompt parameterization per-dataset (csv_prompt_config.yaml parity)
+  via config prompts + df description (extract_df_desc, csv_utils.py:26).
+
+Deliberate divergence: PandasAI executes LLM-written Python; here the
+LLM may only produce a single pandas EXPRESSION, evaluated with no
+builtins and a deny-list — no statements, no imports, no I/O.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Dict, Generator, List
+
+from generativeaiexamples_tpu.pipelines.base import BaseExample, register_example
+
+_LOG = logging.getLogger(__name__)
+
+_CODE_PROMPT = """\
+You are a data analyst. Given this pandas dataframe `df`:
+
+{df_desc}
+
+Write a SINGLE pandas expression (no assignments, no imports, no print)
+that computes the answer to the question. Reply with only the expression
+inside a code block.
+
+Question: {question}"""
+
+_ANSWER_PROMPT = """\
+Question: {question}
+Computation result: {result}
+
+Phrase a concise natural-language answer to the question using the
+result."""
+
+_DENY = re.compile(
+    r"__|\bopen\b|\beval\b|\bexec\b|\bimport\b|to_csv|to_pickle|to_sql|"
+    r"to_excel|to_parquet|read_|\bos\b|\bsys\b|subprocess|getattr|setattr")
+
+
+def extract_df_desc(df) -> str:
+    """Schema + head sample (csv_utils.py:26 parity)."""
+    lines = [f"rows: {len(df)}", "columns:"]
+    for c in df.columns:
+        lines.append(f"  - {c} ({df[c].dtype})")
+    lines.append("head:")
+    lines.append(df.head(3).to_string())
+    return "\n".join(lines)
+
+
+def run_pandas_expression(expr: str, df):
+    """Evaluate one pandas expression with no builtins + deny-list."""
+    import numpy as np
+    import pandas as pd
+
+    expr = expr.strip().strip("`").strip()
+    if ";" in expr or "\n" in expr.strip():
+        raise ValueError("only a single expression is allowed")
+    if _DENY.search(expr):
+        raise ValueError(f"disallowed token in expression: {expr!r}")
+    return eval(expr, {"__builtins__": {}},  # noqa: S307 — guarded above
+                {"df": df, "pd": pd, "np": np})
+
+
+def _extract_code(reply: str) -> str:
+    m = re.search(r"```(?:python)?\s*(.+?)```", reply, re.S)
+    if m:
+        return m.group(1).strip()
+    return reply.strip().splitlines()[-1].strip()
+
+
+@register_example("structured_data")
+class CSVChatbot(BaseExample):
+    MAX_RETRIES = 3  # PandasAI-style retry on bad code
+
+    def _registry(self) -> List[str]:
+        return self.res.extras.setdefault("csv_files", [])
+
+    def _frame(self):
+        import pandas as pd
+
+        files = self._registry()
+        if not files:
+            return None
+        return pd.concat([pd.read_csv(f) for f in files], ignore_index=True)
+
+    def ingest_docs(self, filepath: str, filename: str) -> None:
+        import pandas as pd
+
+        if not filename.lower().endswith(".csv"):
+            raise ValueError("structured_data pipeline ingests CSV files only")
+        df_new = pd.read_csv(filepath)
+        cur = self._frame()
+        if cur is not None and list(cur.columns) != list(df_new.columns):
+            # column-compat check parity (chains.py:113-131)
+            raise ValueError(
+                f"column mismatch: {filename} has {list(df_new.columns)}, "
+                f"registry has {list(cur.columns)}")
+        self._registry().append(filepath)
+        _LOG.info("registered CSV %s (%d rows)", filename, len(df_new))
+
+    def llm_chain(self, query: str, chat_history, **llm_settings
+                  ) -> Generator[str, None, None]:
+        system = self.res.config.prompts.chat_template
+        messages = ([{"role": "system", "content": system}]
+                    + list(chat_history) + [{"role": "user", "content": query}])
+        yield from self.res.llm.stream_chat(messages, **llm_settings)
+
+    def rag_chain(self, query: str, chat_history, **llm_settings
+                  ) -> Generator[str, None, None]:
+        df = self._frame()
+        if df is None:
+            yield "No CSV data ingested yet; upload a CSV first."
+            return
+        desc = extract_df_desc(df)
+        result = None
+        last_err = ""
+        for attempt in range(self.MAX_RETRIES):
+            prompt = _CODE_PROMPT.format(df_desc=desc, question=query)
+            if last_err:
+                prompt += (f"\n\nYour previous expression failed with: "
+                           f"{last_err}. Fix it.")
+            reply = self.res.llm.chat(
+                [{"role": "user", "content": prompt}], max_tokens=256)
+            expr = _extract_code(reply)
+            try:
+                result = run_pandas_expression(expr, df)
+                break
+            except Exception as e:  # retry with the error in the prompt
+                last_err = f"{type(e).__name__}: {e}"
+                _LOG.info("pandas expr attempt %d failed: %s", attempt, last_err)
+        if result is None:
+            yield f"Could not compute an answer from the data ({last_err})."
+            return
+        result_str = str(result)
+        if len(result_str) > 2000:
+            result_str = result_str[:2000] + "..."
+        yield from self.res.llm.stream_chat([{
+            "role": "user",
+            "content": _ANSWER_PROMPT.format(question=query, result=result_str),
+        }], **llm_settings)
+
+    def get_documents(self) -> List[str]:
+        return [os.path.basename(f) for f in self._registry()]
+
+    def delete_documents(self, filenames: List[str]) -> bool:
+        names = set(filenames)
+        reg = self._registry()
+        before = len(reg)
+        self.res.extras["csv_files"] = [
+            f for f in reg if os.path.basename(f) not in names]
+        return len(self.res.extras["csv_files"]) < before

@@ -278,47 +278,75 @@ class LLMEngine:
         self._emit(slot, tok)
 
     def _decode(self) -> None:
+        """K fused decode steps in ONE dispatch (decode_steps_per_dispatch):
+        sampling happens on device, the host sees only the [B, K] token
+        block — per-step dispatch overhead is the dominant cost of
+        single-token decoding at serving batch sizes."""
         B = len(self.slots)
+        K = max(1, self.ecfg.decode_steps_per_dispatch)
         tokens = np.zeros((B,), np.int32)
         lengths = np.ones((B,), np.int32)
         tables = np.zeros((B, self.max_pages), np.int32)
         temps = np.zeros((B,), np.float32)
         top_ps = np.ones((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
-        active: List[int] = []
+        active_mask = np.zeros((B,), bool)
+        live: List[int] = []
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
             if s.req.cancelled:
                 self._finish(i, "cancelled")
                 continue
-            new_len = s.seq.length + 1  # position of the incoming token
+            cap = self.max_pages * self.pool.page_size - s.seq.length
+            if cap < 1 or self.allocator.n_free * self.pool.page_size < 1:
+                self._finish(i, "length")
+                continue
+            live.append(i)
+        if not live:
+            return
+        # Shared fused-step count: bounded by every slot's page capacity,
+        # bucketed to powers of two so only log2(K) shapes ever compile.
+        cap_steps = min(self.max_pages * self.pool.page_size
+                        - self.slots[i].seq.length for i in live)
+        K = min(K, max(1, cap_steps))
+        while K & (K - 1):
+            K &= K - 1
+        active: List[int] = []
+        for i in live:
+            s = self.slots[i]
+            base_len = s.seq.length
             try:
-                s.seq.ensure(new_len)
+                s.seq.ensure(base_len + K)
             except MemoryError:
-                self._finish(i, "length")  # out of pages: stop this request
+                self._finish(i, "length")  # pool exhausted (shared pages)
                 continue
             active.append(i)
+            active_mask[i] = True
             tokens[i] = s.last_token
-            lengths[i] = new_len
+            lengths[i] = base_len + 1  # incl. the incoming token
             tables[i] = s.seq.table_row()
             temps[i] = s.req.temperature
             top_ps[i] = s.req.top_p
             top_ks[i] = s.req.top_k
         if not active:
             return
-        logits, self.pool = engine_model.decode_step(
+        tok_block, self.pool = engine_model.decode_multi_step(
             self.params, self.cfg, self.pool, jnp.asarray(tokens),
-            jnp.asarray(tables), jnp.asarray(lengths), self.use_pallas)
-        sp = SamplingParams(jnp.asarray(temps), jnp.asarray(top_ps),
-                            jnp.asarray(top_ks))
-        next_tokens = np.asarray(sample(logits, sp, self._next_key()))
-        self.metrics.decode_steps += 1
-        self.metrics.busy_slots_acc += len(active)
-        for i in active:
-            s = self.slots[i]
-            s.last_token = int(next_tokens[i])
-            self._emit(s, s.last_token, slot_idx=i)
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(active_mask), jnp.asarray(temps),
+            jnp.asarray(top_ps), jnp.asarray(top_ks),
+            self._next_key(), K, self.use_pallas)
+        tok_block = np.asarray(tok_block)  # [B, K]
+        self.metrics.decode_steps += K
+        self.metrics.busy_slots_acc += len(active) * K
+        for j in range(K):
+            for i in active:
+                s = self.slots[i]
+                if s is None:  # finished at an earlier fused step
+                    continue
+                s.last_token = int(tok_block[i, j])
+                self._emit(s, s.last_token, slot_idx=i)
 
     def _emit(self, slot: _Slot, tok: int, slot_idx: Optional[int] = None) -> None:
         self.metrics.tokens_out += 1

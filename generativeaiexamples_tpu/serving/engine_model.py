@@ -29,7 +29,7 @@ from generativeaiexamples_tpu.ops import attention as attn_ops
 from generativeaiexamples_tpu.ops.quant import mm
 from generativeaiexamples_tpu.serving.kv_cache import PagePool
 from generativeaiexamples_tpu.serving.paged_attention import (
-    paged_attention_dispatch)
+    paged_attention_dispatch, paged_attention_with_new)
 
 
 def _project_qkv(cfg: LlamaConfig, h, w, positions):
@@ -55,6 +55,19 @@ def _logits(cfg: LlamaConfig, params, x):
     return mm(x, params["lm_head"]).astype(jnp.float32)
 
 
+def _write_pages_all_layers(pool: PagePool, k_stack, v_stack, page_idx, offset
+                            ) -> PagePool:
+    """One scatter per pool array writes every layer's new token k/v.
+    k_stack/v_stack: [L, B, KH, Hd]; page_idx/offset: [B]."""
+    L = pool.k.shape[0]
+    li = jnp.arange(L)[:, None]
+    k = pool.k.at[li, page_idx[None, :], :, offset[None, :], :].set(
+        k_stack.astype(pool.k.dtype))
+    v = pool.v.at[li, page_idx[None, :], :, offset[None, :], :].set(
+        v_stack.astype(pool.v.dtype))
+    return PagePool(k, v, pool.page_size)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas"),
                    donate_argnames=("pool",))
 def prefill_step(
@@ -64,7 +77,12 @@ def prefill_step(
     table_row: jax.Array,   # [S_bucket // page_size] page ids (0-padded)
     use_pallas: Optional[bool] = None,
 ) -> Tuple[jax.Array, PagePool]:
-    """Prefill one sequence; returns (last-token logits [V], pool)."""
+    """Prefill one sequence; returns (last-token logits [V], pool).
+
+    The layer scan only READS weights and returns the per-layer k/v
+    ([L, S, KH, Hd], a few MB); the page pool is written once afterwards
+    — never re-stacked through scan outputs (that would copy the whole
+    pool per call)."""
     _, S = tokens.shape
     ps = pool.page_size
     npages = S // ps
@@ -74,24 +92,77 @@ def prefill_step(
 
     x = params["tok_emb"][tokens].astype(cfg.dtype)
 
-    def body(x, layer):
-        w, kp, vp = layer  # kp/vp: [P, KH, ps, Hd] for this layer
+    def body(x, w):
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)
         out = attn_ops.attention(q, k, v, causal=True, lengths=lengths,
                                  use_pallas=use_pallas)
-        # write pages: [1, KH, S, Hd] -> [npages, KH, ps, Hd]
-        kw = k[0].reshape(KH, npages, ps, Hd).transpose(1, 0, 2, 3)
-        vw = v[0].reshape(KH, npages, ps, Hd).transpose(1, 0, 2, 3)
-        kp = kp.at[table_row].set(kw.astype(kp.dtype))
-        vp = vp.at[table_row].set(vw.astype(vp.dtype))
-        return _finish_block(cfg, x, out, w), (kp, vp)
+        x = _finish_block(cfg, x, out, w)
+        return x, (k[0].transpose(1, 0, 2), v[0].transpose(1, 0, 2))  # [S,KH,Hd]
 
-    x, (k_out, v_out) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
+    x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
+    # [L, S, KH, Hd] -> pages [L, npages, KH, ps, Hd] -> scatter once
+    L = k_stack.shape[0]
+    kw = k_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
+    vw = v_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
+    li = jnp.arange(L)[:, None]
+    k = pool.k.at[li, table_row[None, :]].set(kw.astype(pool.k.dtype))
+    v = pool.v.at[li, table_row[None, :]].set(vw.astype(pool.v.dtype))
     last = jnp.take_along_axis(
         x, (length - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)  # [1,1,D]
     logits = _logits(cfg, params, last)[0, 0]
-    return logits, PagePool(k_out, v_out, ps)
+    return logits, PagePool(k, v, ps)
+
+
+import os
+
+# Layer-loop strategy for the decode step. Unrolled (default) lets XLA
+# fuse each layer's weight-stack slice directly into its matmul instead
+# of materializing per-iteration copies of the sliced operands, which
+# dominates decode time at small batch; scan compiles faster (useful on
+# the CPU test backend). Env knob for benchmarking both.
+_UNROLL_DECODE = os.environ.get("ENGINE_UNROLL_DECODE", "1") != "0"
+
+
+def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
+                 lengths, use_pallas):
+    """One decode iteration: logits + the new k/v stacks (pool untouched)."""
+    B = tokens.shape[0]
+    positions = (lengths - 1)[:, None]  # [B, 1]
+
+    x = params["tok_emb"][tokens[:, None]].astype(cfg.dtype)  # [B, 1, D]
+
+    def body(x, layer):
+        w, kp, vp = layer  # kp/vp read-only views of the pool
+        h = rms_norm(x, w["ln1"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, h, w, positions)  # [B, *, 1, Hd]
+        k_new, v_new = k[:, :, 0, :], v[:, :, 0, :]
+        out = paged_attention_with_new(
+            q[:, :, 0, :], kp, vp, page_tables, lengths, k_new, v_new,
+            use_pallas=use_pallas)
+        x = _finish_block(cfg, x, out[:, :, None, :], w)
+        return x, (k_new, v_new)
+
+    if _UNROLL_DECODE:
+        from generativeaiexamples_tpu.ops.quant import QuantizedTensor
+
+        def take(t, l):
+            if isinstance(t, QuantizedTensor):
+                return QuantizedTensor(t.q[l], t.s[l])
+            return t[l]
+
+        k_news, v_news = [], []
+        for l in range(cfg.n_layers):
+            w = {k2: take(v2, l) for k2, v2 in params["layers"].items()}
+            x, (k_new, v_new) = body(x, (w, pool.k[l], pool.v[l]))
+            k_news.append(k_new)
+            v_news.append(v_new)
+        k_stack = jnp.stack(k_news)
+        v_stack = jnp.stack(v_news)
+    else:
+        x, (k_stack, v_stack) = jax.lax.scan(
+            body, x, (params["layers"], pool.k, pool.v))
+    return _logits(cfg, params, x)[:, 0], k_stack, v_stack
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas"),
@@ -106,21 +177,49 @@ def decode_step(
     """One decode step for the whole slot batch -> (logits [B, V], pool)."""
     B = tokens.shape[0]
     ps = pool.page_size
-    positions = (lengths - 1)[:, None]  # [B, 1]
     page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]  # [B]
     offset = (lengths - 1) % ps  # [B]
+    logits, k_stack, v_stack = _decode_once(
+        params, cfg, pool, tokens, page_tables, lengths, use_pallas)
+    pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)
+    return logits, pool
 
-    x = params["tok_emb"][tokens[:, None]].astype(cfg.dtype)  # [B, 1, D]
 
-    def body(x, layer):
-        w, kp, vp = layer
-        h = rms_norm(x, w["ln1"], cfg.rms_eps)
-        q, k, v = _project_qkv(cfg, h, w, positions)  # q/k/v [B, *, 1, Hd]
-        kp = kp.at[page_idx, :, offset, :].set(k[:, :, 0, :].astype(kp.dtype))
-        vp = vp.at[page_idx, :, offset, :].set(v[:, :, 0, :].astype(vp.dtype))
-        out = paged_attention_dispatch(
-            q[:, :, 0, :], kp, vp, page_tables, lengths, use_pallas=use_pallas)
-        return _finish_block(cfg, x, out[:, :, None, :], w), (kp, vp)
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas"),
+                   donate_argnames=("pool",))
+def decode_multi_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    tokens: jax.Array,        # [B]
+    page_tables: jax.Array,   # [B, maxp]
+    lengths: jax.Array,       # [B] incl. current token
+    active: jax.Array,        # [B] bool — inactive slots don't advance
+    temperature: jax.Array,   # [B]
+    top_p: jax.Array,         # [B]
+    top_k: jax.Array,         # [B]
+    rng: jax.Array,
+    n_steps: int,
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jax.Array, PagePool]:
+    """n_steps fused decode iterations with ON-DEVICE sampling — one
+    dispatch instead of n (amortizes host/dispatch overhead, the
+    dominant cost of single-step decoding at small batch). Sequences
+    must have page capacity for n_steps more tokens (caller ensures).
+    Returns (sampled tokens [B, n_steps], pool)."""
+    from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
 
-    x, (k_out, v_out) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
-    return _logits(cfg, params, x)[:, 0], PagePool(k_out, v_out, ps)
+    B = tokens.shape[0]
+    ps = pool.page_size
+    sp = SamplingParams(temperature, top_p, top_k)
+    out_tokens = []
+    for i in range(n_steps):
+        logits, k_stack, v_stack = _decode_once(
+            params, cfg, pool, tokens, page_tables, lengths, use_pallas)
+        page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]
+        offset = (lengths - 1) % ps
+        pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits, sp, key)
+        tokens = jnp.where(active, nxt, tokens)
+        out_tokens.append(tokens)
+        lengths = jnp.where(active, lengths + 1, lengths)
+    return jnp.stack(out_tokens, axis=1), pool

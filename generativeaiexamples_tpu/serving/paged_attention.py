@@ -63,6 +63,8 @@ def _paged_kernel(
     k_ref,  # [1, KH, ps, Hd]  (page selected by index_map)
     v_ref,
     o_ref,  # [1, H, Hd]
+    m_out_ref,  # [1, H, 128]  softmax running max (lane-broadcast; TPU
+    l_out_ref,  # [1, H, 128]  block shapes need (8,128)-tileable dims)
     m_ref,  # scratch [H, 128]
     l_ref,  # scratch [H, 128]
     acc_ref,  # scratch [H, Hd]
@@ -121,12 +123,15 @@ def _paged_kernel(
     def _finish():
         denom = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        m_out_ref[0] = m_ref[...]
+        l_out_ref[0] = l_ref[...]
 
 
 def paged_attention(
     q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     page_table: jax.Array, lengths: jax.Array, *,
     scale: Optional[float] = None, interpret: bool = False,
+    return_softmax_state: bool = False,
 ) -> jax.Array:
     """Pallas paged decode attention. See module docstring for layouts."""
     if pltpu is None:
@@ -149,20 +154,31 @@ def paged_attention(
             pl.BlockSpec((1, KH, ps, Hd), lambda b, p, L, T: (T[b * maxp + p], 0, 0, 0)),
             pl.BlockSpec((1, KH, ps, Hd), lambda b, p, L, T: (T[b * maxp + p], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
+        out_specs=[
+            pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda b, p, L, T: (b, 0, 0)),
+            pl.BlockSpec((1, H, 128), lambda b, p, L, T: (b, 0, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((H, 128), jnp.float32),
             pltpu.VMEM((H, 128), jnp.float32),
             pltpu.VMEM((H, Hd), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    out, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
+        ],
         interpret=interpret,
     )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
       q, k_pages, v_pages)
+    if return_softmax_state:
+        return out, m[:, :, 0], l[:, :, 0]
+    return out
 
 
 def paged_attention_dispatch(q, k_pages, v_pages, page_table, lengths, *,
@@ -172,3 +188,59 @@ def paged_attention_dispatch(q, k_pages, v_pages, page_table, lengths, *,
         return paged_attention(q, k_pages, v_pages, page_table, lengths, scale=scale)
     return paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
                                      scale=scale)
+
+
+def paged_attention_with_new(
+    q: jax.Array,            # [B, H, Hd] current-token queries
+    k_pages: jax.Array,      # [P, KH, ps, Hd] pool WITHOUT the new token
+    v_pages: jax.Array,
+    page_table: jax.Array,   # [B, maxp]
+    lengths: jax.Array,      # [B] INCLUDING the new token
+    k_new: jax.Array,        # [B, KH, Hd] current-token key
+    v_new: jax.Array,
+    *, scale: Optional[float] = None, use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention where the current token's k/v have NOT been
+    written to the pool yet. This keeps the page pool read-only inside
+    the per-layer scan (writes batch into one post-scan scatter — the
+    pool never round-trips through scan carries/stacked outputs, which
+    would copy the whole pool every step). The current token's
+    contribution is merged with the kernel's online-softmax state."""
+    B, H, Hd = q.shape
+    KH = k_pages.shape[1]
+    group = H // KH
+    scale = scale if scale is not None else Hd ** -0.5
+    old = lengths - 1  # tokens actually in the pool
+    use_pallas = (jax.default_backend() == "tpu") if use_pallas is None \
+        else use_pallas
+
+    if use_pallas and pltpu is not None:
+        out, m, l = paged_attention(
+            q, k_pages, v_pages, page_table, old, scale=scale,
+            interpret=interpret, return_softmax_state=True)
+        s = (q.reshape(B, KH, group, Hd).astype(jnp.float32)
+             * k_new[:, :, None, :].astype(jnp.float32)).sum(-1) * scale
+        s = s.reshape(B, H)  # [B, H] self-attention logit
+        m2 = jnp.maximum(m, s)
+        alpha = jnp.exp(m - m2)
+        beta = jnp.exp(s - m2)
+        v_exp = jnp.repeat(v_new, group, axis=1).astype(jnp.float32)  # [B,H,Hd]
+        num = (out.astype(jnp.float32) * (l * alpha)[..., None]
+               + beta[..., None] * v_exp)
+        den = (l * alpha + beta)[..., None]
+        return (num / den).astype(q.dtype)
+
+    # XLA path: gather pages, place the new token at its position, mask.
+    P, _, ps, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    k = k_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
+    v = v_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
+    bidx = jnp.arange(B)
+    k = k.at[bidx, :, old, :].set(k_new.astype(k.dtype))
+    v = v.at[bidx, :, old, :].set(v_new.astype(v.dtype))
+    from generativeaiexamples_tpu.ops.attention import mha_reference
+
+    out = mha_reference(q[:, :, None, :], k, v, causal=False, lengths=lengths,
+                        scale=scale)
+    return out[:, :, 0, :]

@@ -187,6 +187,29 @@ def _stream_text(payload: bytes) -> str:
     return text
 
 
+def extract_images(path: str) -> List[Tuple[str, bytes]]:
+    """Embedded raster images as (format, bytes). JPEG (/DCTDecode)
+    streams carry their own container; other encodings are skipped (no
+    imaging libs in the environment to re-encode raw pixel data)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    pdf = _PDF(data)
+    out: List[Tuple[str, bytes]] = []
+    for body in pdf.objects.values():
+        if b"/Subtype" not in body or b"/Image" not in body:
+            continue
+        m = _STREAM_RE.search(body)
+        if not m:
+            continue
+        raw = body[m.end():]
+        end = raw.rfind(b"endstream")
+        if end >= 0:
+            raw = raw[:end].rstrip(b"\r\n")
+        if b"/DCTDecode" in body[:m.start()]:
+            out.append(("jpeg", raw))
+    return out
+
+
 def extract_text(path: str) -> str:
     """Whole-document text, pages separated by form feeds."""
     with open(path, "rb") as fh:

@@ -1,0 +1,72 @@
+"""Eval harness: metric math with scripted judges, synthetic QA, ragas score."""
+
+import pytest
+
+from generativeaiexamples_tpu.connectors.fakes import EchoLLM, HashEmbedder
+from generativeaiexamples_tpu.eval.harness import generate_synthetic_qa
+from generativeaiexamples_tpu.eval.metrics import (
+    RagasEvaluator, calculate_ragas_score, eval_llm_judge)
+
+ROW = {
+    "question": "How much HBM does v5e have?",
+    "generated_answer": "It has 16 GB of HBM.",
+    "retrieved_context": ["TPU v5e has 16 GB HBM per chip."],
+    "ground_truth_answer": "16 GB per chip.",
+}
+
+
+class YesLLM(EchoLLM):
+    def stream_chat(self, messages, **kw):
+        self.calls.append(list(messages))
+        yield "yes"
+
+
+class NoLLM(EchoLLM):
+    def stream_chat(self, messages, **kw):
+        yield "no"
+
+
+def test_all_yes_gives_perfect_scores():
+    ev = RagasEvaluator(YesLLM(), HashEmbedder(32))
+    res = ev.evaluate([ROW])
+    for m in ("faithfulness", "context_relevancy", "answer_relevancy",
+              "context_recall", "context_precision"):
+        assert res[m] == 1.0, (m, res)
+    assert res["ragas_score"] == pytest.approx(1.0)
+    assert 0 < res["answer_similarity"] <= 1.0
+
+
+def test_all_no_gives_zero_ragas():
+    ev = RagasEvaluator(NoLLM(), None)
+    res = ev.evaluate([ROW])
+    assert res["faithfulness"] == 0.0
+    assert res["ragas_score"] == 0.0
+
+
+def test_harmonic_score_matches_reference_formula():
+    vals = {"faithfulness": 1.0, "context_relevancy": 0.5,
+            "answer_relevancy": 1.0, "context_recall": 0.5}
+    # harmonic mean of (1, .5, 1, .5) = 4 / (1+2+1+2) = 2/3
+    assert calculate_ragas_score(vals) == pytest.approx(2 / 3)
+
+
+def test_llm_judge_parses_json_rating():
+    judge = EchoLLM(script=[(
+        "grading answers",
+        '{"rating": 4, "explanation": "close enough"}')])
+    out = eval_llm_judge(judge, [ROW, ROW])
+    assert out["mean_rating"] == 4.0
+    assert out["rated"] == 2
+    assert out["details"][0]["explanation"] == "close enough"
+
+
+def test_synthetic_qa_generation():
+    llm = EchoLLM(script=[(
+        "question-answer pair",
+        '{"question": "What is the MXU?", "answer": "A systolic array."}')])
+    rows = generate_synthetic_qa(llm, ["The MXU is a systolic array."])
+    assert rows == [{
+        "question": "What is the MXU?",
+        "ground_truth_answer": "A systolic array.",
+        "ground_truth_context": "The MXU is a systolic array.",
+    }]

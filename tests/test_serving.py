@@ -194,3 +194,42 @@ class TestSampling:
         quant, _ = llama.forward(qparams, TINY, toks)
         # int8 weight-only: logits close enough to preserve argmax mostly
         assert jnp.mean(jnp.abs(full - quant)) < 0.15
+
+
+class TestPagedAttentionWithNew:
+    def test_matches_write_then_attend(self):
+        """Merged-softmax decode (pool untouched) must equal writing the
+        token first and attending over the updated pool."""
+        from generativeaiexamples_tpu.serving.paged_attention import (
+            paged_attention_reference, paged_attention_with_new)
+
+        B, H, KH, Hd, ps, maxp, P = 2, 4, 2, 16, 8, 4, 16
+        q = _rand((B, H, Hd), 10)
+        kp = _rand((P, KH, ps, Hd), 11)
+        vp = _rand((P, KH, ps, Hd), 12)
+        k_new = _rand((B, KH, Hd), 13)
+        v_new = _rand((B, KH, Hd), 14)
+        table = jnp.asarray(
+            np.arange(1, 1 + B * maxp).reshape(B, maxp).astype(np.int32))
+        lengths = jnp.array([ps * 2 + 4, 7], jnp.int32)  # incl. new token
+
+        # ground truth: write new kv into the pool, then attend
+        bidx = np.arange(B)
+        page_idx = np.asarray(table)[bidx, (np.asarray(lengths) - 1) // ps]
+        off = (np.asarray(lengths) - 1) % ps
+        kp2 = np.asarray(kp).copy()
+        vp2 = np.asarray(vp).copy()
+        kp2[page_idx, :, off, :] = np.asarray(k_new)
+        vp2[page_idx, :, off, :] = np.asarray(v_new)
+        want = paged_attention_reference(
+            q, jnp.asarray(kp2), jnp.asarray(vp2), table, lengths)
+
+        got_ref = paged_attention_with_new(
+            q, kp, vp, table, lengths, k_new, v_new, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want),
+                                   atol=2e-5)
+        got_pl = paged_attention_with_new(
+            q, kp, vp, table, lengths, k_new, v_new, use_pallas=True,
+            interpret=True)
+        np.testing.assert_allclose(np.asarray(got_pl), np.asarray(want),
+                                   atol=2e-5)
