@@ -157,6 +157,22 @@ def llama_config_from_hf(path: str) -> llama_lib.LlamaConfig:
     """Derive LlamaConfig from an HF snapshot's config.json."""
     with open(os.path.join(path, "config.json")) as fh:
         c = json.load(fh)
+    rs = c.get("rope_scaling") or None
+    scaling = None
+    if rs is not None:
+        rope_type = rs.get("rope_type", rs.get("type"))
+        if rope_type != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {rope_type!r} in {path}; "
+                "only llama3-style frequency scaling is implemented"
+            )
+        scaling = llama_lib.RopeScaling(
+            factor=float(rs["factor"]),
+            low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            original_max_position_embeddings=int(
+                rs.get("original_max_position_embeddings", 8192)),
+        )
     return llama_lib.LlamaConfig(
         vocab_size=c["vocab_size"],
         dim=c["hidden_size"],
@@ -169,6 +185,7 @@ def llama_config_from_hf(path: str) -> llama_lib.LlamaConfig:
         rms_eps=c.get("rms_norm_eps", 1e-5),
         max_seq_len=c.get("max_position_embeddings", 8192),
         tie_embeddings=c.get("tie_word_embeddings", False),
+        rope_scaling=scaling,
     )
 
 

@@ -38,6 +38,23 @@ Params = Dict[str, Any]
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style rope frequency scaling (HF config.json
+    `rope_scaling` with `rope_type: "llama3"`).
+
+    Wavelengths shorter than original_max/high_freq_factor are kept,
+    longer than original_max/low_freq_factor are divided by `factor`,
+    and the band in between is smoothly interpolated — matching HF
+    transformers' `_compute_llama3_parameters`.
+    """
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
     dim: int = 4096
@@ -50,6 +67,7 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
+    rope_scaling: Optional[RopeScaling] = None
     dtype: Any = jnp.bfloat16
 
     @staticmethod
@@ -62,10 +80,18 @@ class LlamaConfig:
                            mlp_dim=28672)
 
     @staticmethod
+    def llama3_1_8b() -> "LlamaConfig":
+        return LlamaConfig(max_seq_len=131072,
+                           rope_scaling=RopeScaling(factor=8.0))
+
+    @staticmethod
     def llama3_2_1b() -> "LlamaConfig":
+        # HF publishes this checkpoint with rope_type "llama3", factor 32.
         return LlamaConfig(vocab_size=128256, dim=2048, n_layers=16,
                            n_heads=32, n_kv_heads=8, head_dim=64,
-                           mlp_dim=8192, tie_embeddings=True)
+                           mlp_dim=8192, tie_embeddings=True,
+                           max_seq_len=131072,
+                           rope_scaling=RopeScaling(factor=32.0))
 
     @staticmethod
     def tiny(vocab_size: int = 256) -> "LlamaConfig":
@@ -140,10 +166,28 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (xf * scale).astype(x.dtype) * w
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def rope_freqs(head_dim: int, theta: float,
+               scaling: Optional[RopeScaling] = None) -> jax.Array:
+    """Inverse frequencies [Hd/2], with optional llama3 scaling."""
+    freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    if scaling is None:
+        return freqs
+    s = scaling
+    wavelen = 2.0 * jnp.pi / freqs
+    high_wl = s.original_max_position_embeddings / s.high_freq_factor
+    low_wl = s.original_max_position_embeddings / s.low_freq_factor
+    smooth = (s.original_max_position_embeddings / wavelen - s.low_freq_factor) \
+        / (s.high_freq_factor - s.low_freq_factor)
+    mid = (1.0 - smooth) * freqs / s.factor + smooth * freqs
+    return jnp.where(wavelen < high_wl, freqs,
+                     jnp.where(wavelen > low_wl, freqs / s.factor, mid))
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         scaling: Optional[RopeScaling] = None) -> jax.Array:
     """Rotary position embedding. x [B, n, S, Hd], positions [B, S]."""
     Hd = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, Hd, 2, dtype=jnp.float32) / Hd)  # [Hd/2]
+    freqs = rope_freqs(Hd, theta, scaling)  # [Hd/2]
     angles = positions[:, None, :, None].astype(jnp.float32) * freqs  # [B,1,S,Hd/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
@@ -190,8 +234,8 @@ def _layer(cfg: LlamaConfig, x, ln1, ln2, wq, wk, wv, wo, w_gate, w_up, w_down,
     q = mm(h, wq).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
     k = mm(h, wk).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
     v = mm(h, wv).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
     if kv is None:
         out = attn_ops.attention(q, k, v, causal=causal, lengths=attn_lengths,

@@ -12,12 +12,14 @@ Parity behaviors:
   via config prompts + df description (extract_df_desc, csv_utils.py:26).
 
 Deliberate divergence: PandasAI executes LLM-written Python; here the
-LLM may only produce a single pandas EXPRESSION, evaluated with no
-builtins and a deny-list — no statements, no imports, no I/O.
+LLM may only produce a single pandas EXPRESSION, validated against an
+AST allow-list (no statements, no imports, no I/O — file-writing
+methods like to_json/to_hdf are rejected structurally, not by regex).
 """
 
 from __future__ import annotations
 
+import ast
 import logging
 import os
 import re
@@ -45,9 +47,96 @@ Computation result: {result}
 Phrase a concise natural-language answer to the question using the
 result."""
 
-_DENY = re.compile(
-    r"__|\bopen\b|\beval\b|\bexec\b|\bimport\b|to_csv|to_pickle|to_sql|"
-    r"to_excel|to_parquet|read_|\bos\b|\bsys\b|subprocess|getattr|setattr")
+# AST allow-list. Only these expression node types may appear; anything
+# else (imports, assignments, await, f-string format specs with !, ...)
+# is rejected before eval.
+_ALLOWED_NODES = (
+    ast.Expression, ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare,
+    ast.Call, ast.Attribute, ast.Subscript, ast.Name, ast.Constant,
+    ast.Tuple, ast.List, ast.Dict, ast.Set, ast.Slice, ast.keyword,
+    ast.Lambda, ast.arguments, ast.arg, ast.IfExp, ast.ListComp,
+    ast.SetComp, ast.DictComp, ast.GeneratorExp, ast.comprehension,
+    ast.Starred,
+    # operator tokens
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+    ast.MatMult, ast.BitAnd, ast.BitOr, ast.BitXor, ast.LShift,
+    ast.RShift, ast.Invert, ast.Not, ast.UAdd, ast.USub, ast.And,
+    ast.Or, ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+    ast.In, ast.NotIn, ast.Is, ast.IsNot, ast.Load,
+)
+
+# Attribute names that are never allowed: dunder/private access, any
+# reader/writer (to_* accept file paths — to_json/to_hdf/to_feather/
+# to_stata/to_html/to_latex all write when given one), eval hooks, and
+# numpy's file I/O.
+_SAFE_TO_METHODS = frozenset(
+    {"to_dict", "to_list", "tolist", "to_numpy", "to_frame", "to_records",
+     "to_flat_index", "to_series", "to_datetime", "to_numeric",
+     "to_timedelta", "to_period", "to_timestamp"})
+_DENY_ATTRS = frozenset(
+    {"eval", "query", "pipe", "save", "savetxt", "savez",
+     "savez_compressed", "dump", "dumps", "tofile", "fromfile", "load",
+     "loads", "memmap", "DataSource", "genfromtxt", "loadtxt", "io",
+     "open_memmap", "load_library", "compile"})
+# np/pd submodules that reach file I/O, ctypes loading, or subprocesses
+# (np.lib.format.open_memmap, np.ctypeslib.load_library, np.f2py.compile).
+_DENY_SUBMODULES = frozenset(
+    {"lib", "ctypeslib", "f2py", "testing", "distutils", "compat",
+     "core", "ma", "char", "rec", "emath", "polynomial", "api",
+     "arrays", "errors", "util"})
+_ROOT_NAMES = frozenset({"df", "pd", "np"})
+
+
+def _attr_denied(a: str) -> bool:
+    return (a.startswith("_") or a.startswith("read_")
+            or (a.startswith("to_") and a not in _SAFE_TO_METHODS)
+            or a in _DENY_ATTRS)
+
+
+def _validate_expr_ast(expr: str) -> None:
+    """Raise ValueError unless `expr` is a single side-effect-free
+    pandas/numpy expression under the allow-list above."""
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise ValueError(f"not a valid expression: {e}") from None
+    bound: set = set()  # lambda params + comprehension targets
+    for node in ast.walk(tree):
+        if isinstance(node, ast.arg):
+            bound.add(node.arg)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            if isinstance(node, (ast.Store,)):  # comprehension targets
+                continue
+            raise ValueError(
+                f"disallowed syntax: {type(node).__name__}")
+        if isinstance(node, ast.Attribute):
+            a = node.attr
+            if _attr_denied(a):
+                raise ValueError(f"disallowed attribute: {a!r}")
+            # np.lib.…, pd.io.… — block the dangerous submodule roots
+            # outright; method access on df/Series never needs them.
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id in ("np", "pd")
+                    and a in _DENY_SUBMODULES):
+                raise ValueError(f"disallowed submodule: {node.value.id}.{a}")
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id not in _ROOT_NAMES and node.id not in bound:
+                raise ValueError(f"disallowed name: {node.id!r}")
+        if isinstance(node, ast.keyword) and node.arg and (
+                node.arg in ("buf", "path", "path_or_buf",
+                             "filepath_or_buffer", "engine")):
+            raise ValueError(f"disallowed keyword argument: {node.arg!r}")
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # pandas dispatches method NAMES passed as strings (e.g.
+            # df.apply('to_csv')); vet string literals like attributes.
+            if _attr_denied(node.value):
+                raise ValueError(
+                    f"disallowed method name in string: {node.value!r}")
 
 
 def extract_df_desc(df) -> str:
@@ -61,16 +150,15 @@ def extract_df_desc(df) -> str:
 
 
 def run_pandas_expression(expr: str, df):
-    """Evaluate one pandas expression with no builtins + deny-list."""
+    """Evaluate one pandas expression, AST-validated first."""
     import numpy as np
     import pandas as pd
 
     expr = expr.strip().strip("`").strip()
     if ";" in expr or "\n" in expr.strip():
         raise ValueError("only a single expression is allowed")
-    if _DENY.search(expr):
-        raise ValueError(f"disallowed token in expression: {expr!r}")
-    return eval(expr, {"__builtins__": {}},  # noqa: S307 — guarded above
+    _validate_expr_ast(expr)
+    return eval(expr, {"__builtins__": {}},  # noqa: S307 — AST-validated above
                 {"df": df, "pd": pd, "np": np})
 
 

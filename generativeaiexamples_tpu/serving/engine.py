@@ -268,7 +268,10 @@ class LLMEngine:
             self.params, self.cfg, self.pool, jnp.asarray(tokens),
             jnp.int32(len(ids)), jnp.asarray(row), self.use_pallas)
         sp = SamplingParams.make(1, req.temperature, req.top_p, req.top_k)
-        tok = int(sample(logits[None, :], sp, self._next_key())[0])
+        tok = int(sample(logits[None, :], sp, self._next_key(),
+                         all_greedy=req.temperature <= 0.0,
+                         any_top_k=req.top_k > 0,
+                         any_top_p=req.top_p < 1.0)[0])
         detok = StreamDetokenizer(self.tokenizer)
         slot = _Slot(req, seq, detok)
         slot.last_token = tok
@@ -299,7 +302,7 @@ class LLMEngine:
                 self._finish(i, "cancelled")
                 continue
             cap = self.max_pages * self.pool.page_size - s.seq.length
-            if cap < 1 or self.allocator.n_free * self.pool.page_size < 1:
+            if cap < 1:
                 self._finish(i, "length")
                 continue
             live.append(i)
@@ -313,30 +316,61 @@ class LLMEngine:
         while K & (K - 1):
             K &= K - 1
         active: List[int] = []
-        for i in live:
-            s = self.slots[i]
-            base_len = s.seq.length
-            try:
-                s.seq.ensure(base_len + K)
-            except MemoryError:
-                self._finish(i, "length")  # pool exhausted (shared pages)
-                continue
-            active.append(i)
-            active_mask[i] = True
-            tokens[i] = s.last_token
-            lengths[i] = base_len + 1  # incl. the incoming token
-            tables[i] = s.seq.table_row()
-            temps[i] = s.req.temperature
-            top_ps[i] = s.req.top_p
-            top_ks[i] = s.req.top_k
+        # ensure() pre-advances seq.length, so capture base lengths once —
+        # a shrink-retry pass must re-ensure from the same starting point.
+        base_lens = {i: self.slots[i].seq.length for i in live}
+        while True:
+            shrink_to = None
+            active = []
+            active_mask[:] = False
+            for i in live:
+                s = self.slots[i]
+                if s is None:
+                    continue
+                base_len = base_lens[i]
+                try:
+                    s.seq.ensure(base_len + K)
+                except MemoryError:
+                    # Pool exhausted. Only finish the slot if it cannot
+                    # advance even one token within its allocated pages;
+                    # otherwise shrink K so it (and everyone) continues
+                    # within existing allocations.
+                    in_page_cap = len(s.seq.pages) * self.pool.page_size \
+                        - base_len
+                    if in_page_cap >= 1 and K > 1:
+                        shrink_to = max(1, in_page_cap)
+                        break
+                    if in_page_cap < 1:
+                        self._finish(i, "length")
+                    continue
+                active.append(i)
+                active_mask[i] = True
+                tokens[i] = s.last_token
+                lengths[i] = base_len + 1  # incl. the incoming token
+                tables[i] = s.seq.table_row()
+                temps[i] = s.req.temperature
+                top_ps[i] = s.req.top_p
+                top_ks[i] = s.req.top_k
+            if shrink_to is None:
+                break
+            K = shrink_to
+            while K & (K - 1):  # power-of-two bucket, rounding down
+                K &= K - 1
         if not active:
             return
+        # Static sampling flags from host-known params: a fully greedy
+        # batch (the default) skips all [B, vocab] sort work on device.
+        # Exactly TWO variants per K bucket (all-greedy vs general) so a
+        # sampled request joining a warm greedy batch costs at most one
+        # extra compile, ever — not one per flag combination.
+        all_greedy = bool(all(temps[i] <= 0.0 for i in active))
+        flags = (True, False, False) if all_greedy else (False, True, True)
         tok_block, self.pool = engine_model.decode_multi_step(
             self.params, self.cfg, self.pool, jnp.asarray(tokens),
             jnp.asarray(tables), jnp.asarray(lengths),
             jnp.asarray(active_mask), jnp.asarray(temps),
             jnp.asarray(top_ps), jnp.asarray(top_ks),
-            self._next_key(), K, self.use_pallas)
+            self._next_key(), K, self.use_pallas, sampling_flags=flags)
         tok_block = np.asarray(tok_block)  # [B, K]
         self.metrics.decode_steps += K
         self.metrics.busy_slots_acc += len(active) * K

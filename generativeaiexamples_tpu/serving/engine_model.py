@@ -38,7 +38,8 @@ def _project_qkv(cfg: LlamaConfig, h, w, positions):
     q = mm(h, w["wq"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
     k = mm(h, w["wk"]).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
     v = mm(h, w["wv"]).reshape(B, S, KH, Hd).transpose(0, 2, 1, 3)
-    return rope(q, positions, cfg.rope_theta), rope(k, positions, cfg.rope_theta), v
+    return (rope(q, positions, cfg.rope_theta, cfg.rope_scaling),
+            rope(k, positions, cfg.rope_theta, cfg.rope_scaling), v)
 
 
 def _finish_block(cfg: LlamaConfig, x, out, w):
@@ -185,7 +186,8 @@ def decode_step(
     return logits, pool
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas",
+                                             "sampling_flags"),
                    donate_argnames=("pool",))
 def decode_multi_step(
     params, cfg: LlamaConfig, pool: PagePool,
@@ -199,6 +201,7 @@ def decode_multi_step(
     rng: jax.Array,
     n_steps: int,
     use_pallas: Optional[bool] = None,
+    sampling_flags: Tuple[bool, bool, bool] = (False, True, True),
 ) -> Tuple[jax.Array, PagePool]:
     """n_steps fused decode iterations with ON-DEVICE sampling — one
     dispatch instead of n (amortizes host/dispatch overhead, the
@@ -210,6 +213,7 @@ def decode_multi_step(
     B = tokens.shape[0]
     ps = pool.page_size
     sp = SamplingParams(temperature, top_p, top_k)
+    all_greedy, any_top_k, any_top_p = sampling_flags
     out_tokens = []
     for i in range(n_steps):
         logits, k_stack, v_stack = _decode_once(
@@ -218,7 +222,8 @@ def decode_multi_step(
         offset = (lengths - 1) % ps
         pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)
         rng, key = jax.random.split(rng)
-        nxt = sample(logits, sp, key)
+        nxt = sample(logits, sp, key, all_greedy=all_greedy,
+                     any_top_k=any_top_k, any_top_p=any_top_p)
         tokens = jnp.where(active, nxt, tokens)
         out_tokens.append(tokens)
         lengths = jnp.where(active, lengths + 1, lengths)

@@ -235,6 +235,7 @@ class OpenAIServer:
         full = ""
         finish = None
         n_tokens = 0
+        cut = False
         try:
             async for ev in self._events(req):
                 text, cut = matcher.push(ev["text"])
@@ -248,7 +249,10 @@ class OpenAIServer:
         except asyncio.CancelledError:
             req.cancelled = True  # client disconnected; stop decoding
             raise
-        if finish != "stop":
+        if not cut:
+            # Track the stop-string cut separately from eos (both report
+            # finish_reason "stop"): an eos-ended completion whose tail is
+            # a proper prefix of a stop string must still be flushed.
             full += matcher.flush()
         msg = ({"message": {"role": "assistant", "content": full}}
                if chat else {"text": full})

@@ -50,12 +50,24 @@ def _mask_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
     return jnp.where(keep, logits, -jnp.inf)
 
 
-def sample(logits: jax.Array, params: SamplingParams, key: jax.Array) -> jax.Array:
-    """logits [B, V] -> token ids [B]. temperature==0 rows are greedy."""
+def sample(logits: jax.Array, params: SamplingParams, key: jax.Array,
+           *, all_greedy: bool = False, any_top_k: bool = True,
+           any_top_p: bool = True) -> jax.Array:
+    """logits [B, V] -> token ids [B]. temperature==0 rows are greedy.
+
+    The keyword flags are STATIC (host-known at dispatch time): when the
+    whole batch is greedy the [B, V] sorts and the categorical draw are
+    skipped entirely, and the top-k sort / top-p argsort are each elided
+    when no slot requests them — this is decode hot-path work.
+    """
     greedy = jnp.argmax(logits, axis=-1)
+    if all_greedy:
+        return greedy
     t = jnp.maximum(params.temperature, 1e-6)[:, None]
     scaled = logits.astype(jnp.float32) / t
-    scaled = _mask_top_k(scaled, params.top_k)
-    scaled = _mask_top_p(scaled, params.top_p)
+    if any_top_k:
+        scaled = _mask_top_k(scaled, params.top_k)
+    if any_top_p:
+        scaled = _mask_top_p(scaled, params.top_p)
     sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)

@@ -98,3 +98,58 @@ def test_tp_sharded_forward_matches_single_device(tiny_params, eight_devices):
         fn = jax.jit(lambda p, t: llama.forward(p, TINY, t)[0])
         got = fn(sharded, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_rope_llama3_scaling_matches_hf():
+    """rope_freqs with llama3 scaling == transformers' reference impl."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    scaling = llama.RopeScaling(
+        factor=32.0, low_freq_factor=1.0, high_freq_factor=4.0,
+        original_max_position_embeddings=8192)
+    hf_cfg = HFLlamaConfig(
+        hidden_size=2048, num_attention_heads=32, head_dim=64,
+        rope_theta=500000.0,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 32.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        })
+    inv_freq, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, torch.device("cpu"))
+    ours = llama.rope_freqs(64, 500000.0, scaling)
+    np.testing.assert_allclose(np.asarray(ours), inv_freq.numpy(), rtol=1e-6)
+    # and without scaling the frequencies are plainly theta^(-2i/d)
+    base = llama.rope_freqs(64, 500000.0, None)
+    np.testing.assert_allclose(
+        np.asarray(base),
+        500000.0 ** (-np.arange(0, 64, 2, dtype=np.float32) / 64), rtol=1e-6)
+
+
+def test_hf_loader_parses_rope_scaling(tmp_path):
+    import json as _json
+
+    cfg_json = {
+        "vocab_size": 128256, "hidden_size": 2048, "num_hidden_layers": 16,
+        "num_attention_heads": 32, "num_key_value_heads": 8,
+        "intermediate_size": 8192, "rope_theta": 500000.0,
+        "max_position_embeddings": 131072, "tie_word_embeddings": True,
+        "rope_scaling": {
+            "rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192},
+    }
+    (tmp_path / "config.json").write_text(_json.dumps(cfg_json))
+    from generativeaiexamples_tpu.models.hf_loader import llama_config_from_hf
+
+    cfg = llama_config_from_hf(str(tmp_path))
+    assert cfg.rope_scaling == llama.RopeScaling(
+        factor=32.0, low_freq_factor=1.0, high_freq_factor=4.0,
+        original_max_position_embeddings=8192)
+
+    # unsupported scaling types fail loudly instead of silently degrading
+    cfg_json["rope_scaling"] = {"rope_type": "yarn", "factor": 2.0}
+    (tmp_path / "config.json").write_text(_json.dumps(cfg_json))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config_from_hf(str(tmp_path))

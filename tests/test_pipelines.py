@@ -126,8 +126,30 @@ def test_structured_data_blocks_dangerous_expressions():
 
     df = pd.DataFrame({"x": [1, 2]})
     assert run_pandas_expression("df['x'].sum()", df) == 3
+    # legitimate analyst expressions pass the AST allow-list
+    assert run_pandas_expression("df['x'].to_list()", df) == [1, 2]
+    assert run_pandas_expression(
+        "df['x'].apply(lambda v: v * 2).sum()", df) == 6
+    assert run_pandas_expression(
+        "df[df['x'] > 1]['x'].mean()", df) == 2
     for bad in ("df.to_csv('/tmp/x')", "__import__('os')",
-                "open('/etc/passwd')", "df['x'].sum(); 1"):
+                "open('/etc/passwd')", "df['x'].sum(); 1",
+                # file-writing to_* methods (the old regex missed these)
+                "df.to_json('/tmp/x.json')", "df.to_hdf('/tmp/x.h5', 'k')",
+                "df.to_feather('/tmp/x')", "df.to_stata('/tmp/x.dta')",
+                "df.to_html('/tmp/x.html')", "df.to_latex('/tmp/x.tex')",
+                # structural escapes a regex can't see
+                "df.__class__", "getattr(df, 'to_' + 'csv')('/tmp/x')",
+                "pd.eval('1+1')", "np.save('/tmp/x.npy', df.values)",
+                "df.to_string(buf='/tmp/x')",
+                "[x for x in ().__class__.__bases__]",
+                "df.x.sum() if True else exec('1')",
+                # namespace + string-dispatch escapes (code-review finds)
+                "np.lib.format.open_memmap('/tmp/p.npy', mode='w+',"
+                " shape=(4,), dtype='u1')",
+                "np.ctypeslib.load_library('evil', '/tmp')",
+                "df['x'].agg('to_csv')",
+                "df.apply('to_pickle')"):
         with pytest.raises(ValueError):
             run_pandas_expression(bad, df)
 

@@ -233,3 +233,30 @@ class TestPagedAttentionWithNew:
             interpret=True)
         np.testing.assert_allclose(np.asarray(got_pl), np.asarray(want),
                                    atol=2e-5)
+
+
+class TestPoolPressure:
+    def test_slot_continues_within_allocated_pages_when_pool_dry(self):
+        """With zero free pages a slot whose current page still has room
+        must keep decoding (not be cut with finish_reason 'length')."""
+        params = llama.init_params(TINY, jax.random.PRNGKey(0))
+        # 1 slot, page_size 8, exactly enough pages for one sequence of
+        # 4 pages (n_pages=5 incl. sink) -> allocator runs dry as soon as
+        # the sequence holds all 4.
+        ecfg = EngineConfig(max_batch_size=1, max_seq_len=32, page_size=8,
+                            prefill_buckets=(8,), decode_steps_per_dispatch=8)
+        eng = LLMEngine(params, TINY, ByteTokenizer(), ecfg, n_pages=5,
+                        use_pallas=False).start()
+        try:
+            # Misaligned prompt (6 tokens, not a page multiple): the pool
+            # hits n_free==0 mid-page, where the old engine finished the
+            # slot with 'length' despite in-page capacity remaining. The
+            # shrink-retry path must instead complete all 26 tokens
+            # (6 + 26 == 32 == max_seq_len exactly).
+            events = list(eng.generate_stream(list(range(6)),
+                                              max_new_tokens=26))
+            toks = [e["token_id"] for e in events if e["token_id"] >= 0]
+            assert len(toks) == 26, events[-1]
+            assert events[-1]["finish_reason"] in ("length", "stop")
+        finally:
+            eng.stop()
