@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from generativeaiexamples_tpu.config.schema import AppConfig
+from generativeaiexamples_tpu.obs import tracing
 
 _LOG = logging.getLogger(__name__)
 
@@ -67,6 +68,7 @@ class ChainServer:
         from generativeaiexamples_tpu.pipelines.resources import Resources
 
         self.config = config
+        tracing.setup(config)  # no-op unless tracing.enabled/ENABLE_TRACING
         if example is not None:
             self.example = example
         else:
@@ -129,6 +131,8 @@ class ChainServer:
             "stop": [sanitize(s) for s in (body.get("stop") or [])],
         }
         rid = str(uuid.uuid4())
+        # W3C traceparent from the caller (reference common/tracing.py:62-73)
+        trace_ctx = tracing.extract_context(dict(request.headers))
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream", "Cache-Control": "no-cache"})
@@ -138,7 +142,15 @@ class ChainServer:
         q: asyncio.Queue = asyncio.Queue()
         DONE = object()
 
+        gspan = tracing.GenerationSpan("generate", context=trace_ctx)
+        gspan.__enter__()
+        gspan.sp.set_attribute("use_knowledge_base", use_kb)
+        gspan.sp.set_attribute("request_id", rid)
+
         def run_chain():
+            # The chain runs in an executor thread: re-attach the caller's
+            # trace context so retriever/engine spans parent correctly.
+            tok = tracing.attach_context(trace_ctx)
             try:
                 gen = (self.example.rag_chain(query, chat_history, **llm_settings)
                        if use_kb else
@@ -152,6 +164,7 @@ class ChainServer:
                     "Error from chain server. Please check chain-server logs "
                     f"for more details. ({type(e).__name__})")
             finally:
+                tracing.detach_context(tok)
                 loop.call_soon_threadsafe(q.put_nowait, DONE)
 
         fut = loop.run_in_executor(self._executor, run_chain)
@@ -160,6 +173,7 @@ class ChainServer:
                 piece = await q.get()
                 if piece is DONE:
                     break
+                gspan.on_token()
                 frame = json.dumps(_chain_response(rid, piece))
                 await resp.write(f"data: {frame}\n\n".encode())
             # sentinel frame (reference server.py:302-307)
@@ -171,6 +185,7 @@ class ChainServer:
             raise
         finally:
             await asyncio.shield(fut)
+            gspan.__exit__(None, None, None)
         return resp
 
     # -- /documents --------------------------------------------------------

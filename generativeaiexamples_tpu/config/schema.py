@@ -34,7 +34,10 @@ class VectorStoreConfig:
     nlist: int = 64  # IVF cells (native/milvus backends)
     nprobe: int = 16  # IVF cells probed at search
     index_type: str = "flat"  # flat | ivf
-    persist_dir: str = "/tmp/gaie_tpu/vectorstore"
+    # Durable store directory ("ingested data persists across sessions",
+    # reference CHANGELOG.md:63). Empty = ephemeral; deployments set it
+    # (deploy/compose.env APP_VECTORSTORE_PERSISTDIR).
+    persist_dir: str = ""
 
 
 @dataclass(frozen=True)

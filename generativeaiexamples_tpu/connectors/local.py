@@ -26,12 +26,13 @@ class LocalEngineLLM(ChatBase):
         text = self.tokenizer.apply_chat_template(messages,
                                                   add_generation_prompt=True)
         ids = self.tokenizer.encode(text)
+        from generativeaiexamples_tpu.obs.tracing import current_context
         from generativeaiexamples_tpu.serving.openai_server import StopStream
 
         matcher = StopStream(list(stop))
         for ev in self.engine.generate_stream(
                 ids, max_new_tokens=max_tokens, temperature=temperature,
-                top_p=top_p):
+                top_p=top_p, trace_context=current_context()):
             piece, hit = matcher.push(ev["text"])
             if piece:
                 yield piece

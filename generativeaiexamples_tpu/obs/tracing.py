@@ -1,13 +1,17 @@
-"""OpenTelemetry tracing: spans across frontend -> chain server -> engine.
+"""Tracing: spans across frontend -> chain server -> engine.
 
 Parity with the reference's tracing glue (common/tracing.py +
 tools/observability/*/opentelemetry_callback.py): W3C traceparent
 propagation over HTTP, spans for generate/retrieve/llm with token
 counts, TTFT event on first token (the reference hooks
 on_llm_new_token, opentelemetry_callback.py:248). Toggled by
-tracing.enabled / ENABLE_TRACING; everything no-ops cleanly when the
-otel SDK is absent or disabled (same import-guard posture as the
-reference, utils.py:26-87).
+tracing.enabled / ENABLE_TRACING.
+
+Backends: the OpenTelemetry SDK when importable; otherwise a built-in
+minimal tracer with the same span/propagation semantics (spans with
+attributes + events, parent/child via W3C traceparent, pluggable
+exporter with `.export([spans])`). The built-in path keeps tracing real
+in environments that ship only the otel namespace shim (this image).
 """
 
 from __future__ import annotations
@@ -15,54 +19,215 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import random
+import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 _LOG = logging.getLogger(__name__)
 
 _TRACER = None
 _ENABLED = False
+_PROVIDER = None
+_BACKEND = None  # "otel" | "mini"
+_TLS = threading.local()  # mini-backend attached context
 
 
-def setup(config=None) -> bool:
-    """Initialize the tracer once per process. Returns enabled state."""
-    global _TRACER, _ENABLED
-    enabled = (os.environ.get("ENABLE_TRACING", "").lower() in ("1", "true")
-               or (config is not None and config.tracing.enabled))
-    if not enabled:
-        _ENABLED = False
+# ---------------------------------------------------------------------------
+# Built-in minimal tracer (used when the otel SDK is unavailable)
+# ---------------------------------------------------------------------------
+
+
+class _MiniContext:
+    """Span context: ints like otel's SpanContext."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+class _MiniEvent:
+    __slots__ = ("name", "attributes", "timestamp")
+
+    def __init__(self, name: str, attributes: Dict):
+        self.name = name
+        self.attributes = dict(attributes)
+        self.timestamp = time.time()
+
+
+class _MiniSpan:
+    def __init__(self, name: str, context: _MiniContext,
+                 parent: Optional[_MiniContext], exporters: List):
+        self.name = name
+        self.context = context
+        self.parent = parent
+        self.attributes: Dict = {}
+        self.events: List[_MiniEvent] = []
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self._exporters = exporters
+
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, attributes: Optional[Dict] = None) -> None:
+        self.events.append(_MiniEvent(name, attributes or {}))
+
+    def end(self) -> None:
+        if self.end_time is not None:
+            return
+        self.end_time = time.time()
+        for ex in self._exporters:
+            try:
+                ex.export([self])
+            except Exception:
+                pass
+
+    # context-manager protocol so `with span(...)` keeps working
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
         return False
+
+
+class _MiniTracer:
+    """start_span-compatible subset of an otel Tracer."""
+
+    def __init__(self):
+        self.exporters: List = []
+
+    def start_span(self, name: str, context=None, attributes=None) -> _MiniSpan:
+        parent = context if isinstance(context, _MiniContext) else \
+            getattr(_TLS, "ctx", None)
+        trace_id = parent.trace_id if parent else random.getrandbits(128)
+        sp = _MiniSpan(name, _MiniContext(trace_id, random.getrandbits(64)),
+                       parent, self.exporters)
+        for k, v in (attributes or {}).items():
+            sp.set_attribute(k, v)
+        return sp
+
+    @contextlib.contextmanager
+    def start_as_current_span(self, name: str, context=None):
+        sp = self.start_span(name, context=context)
+        prev = getattr(_TLS, "ctx", None)
+        _TLS.ctx = sp.context
+        try:
+            yield sp
+        finally:
+            _TLS.ctx = prev
+            sp.end()
+
+
+class MemoryExporter:
+    """In-memory exporter for the built-in backend (API-compatible with
+    otel's InMemorySpanExporter where tests need it)."""
+
+    def __init__(self):
+        self._spans: List[_MiniSpan] = []
+        self._lock = threading.Lock()
+
+    def export(self, spans) -> None:
+        with self._lock:
+            self._spans.extend(spans)
+
+    def get_finished_spans(self):
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+class LogExporter:
+    """Default mini-backend exporter: one structured log line per span."""
+
+    def export(self, spans) -> None:
+        for s in spans:
+            _LOG.info(
+                "span name=%s trace=%032x dur_ms=%.1f attrs=%s events=%s",
+                s.name, s.context.trace_id,
+                ((s.end_time or time.time()) - s.start_time) * 1e3,
+                s.attributes, [e.name for e in s.events])
+
+
+def _parse_traceparent(value: str) -> Optional[_MiniContext]:
+    try:
+        parts = value.strip().split("-")
+        if len(parts) != 4:
+            return None
+        return _MiniContext(int(parts[1], 16), int(parts[2], 16))
+    except Exception:
+        return None
+
+
+def setup(config=None, exporter=None) -> bool:
+    """Initialize the tracer once per process. Returns enabled state.
+
+    Re-invocation (e.g. a second ChainServer in one test process) reuses
+    the existing provider — OTel's global provider can only be set once —
+    and an injected `exporter` is attached with a synchronous processor
+    (tests use InMemorySpanExporter).
+    """
+    global _TRACER, _ENABLED, _PROVIDER, _BACKEND
+    enabled = (os.environ.get("ENABLE_TRACING", "").lower() in ("1", "true")
+               or (config is not None and config.tracing.enabled)
+               or exporter is not None)
+    if not enabled:
+        # Never downgrade: a disabled-config setup() after an explicit
+        # enable (e.g. ChainServer init after test/process-level setup)
+        # leaves the active tracer in place.
+        return _ENABLED
     try:
         from opentelemetry import trace
         from opentelemetry.sdk.resources import Resource
         from opentelemetry.sdk.trace import TracerProvider
         from opentelemetry.sdk.trace.export import (
-            BatchSpanProcessor, ConsoleSpanExporter)
+            BatchSpanProcessor, ConsoleSpanExporter, SimpleSpanProcessor)
 
-        service = (config.tracing.service_name if config else "chain-server")
-        provider = TracerProvider(
-            resource=Resource.create({"service.name": service}))
-        exporter = None
-        endpoint = (config.tracing.otlp_endpoint if config
-                    else os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", ""))
-        if endpoint:
-            try:
-                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter \
-                    import OTLPSpanExporter
+        if _PROVIDER is None:
+            service = (config.tracing.service_name if config
+                       else "chain-server")
+            _PROVIDER = TracerProvider(
+                resource=Resource.create({"service.name": service}))
+            otlp = None
+            endpoint = (config.tracing.otlp_endpoint if config
+                        else os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", ""))
+            if endpoint and exporter is None:
+                try:
+                    from opentelemetry.exporter.otlp.proto.grpc \
+                        .trace_exporter import OTLPSpanExporter
 
-                exporter = OTLPSpanExporter(endpoint=endpoint, insecure=True)
-            except Exception:
-                _LOG.warning("OTLP exporter unavailable; using console")
-        provider.add_span_processor(
-            BatchSpanProcessor(exporter or ConsoleSpanExporter()))
-        trace.set_tracer_provider(provider)
+                    otlp = OTLPSpanExporter(endpoint=endpoint, insecure=True)
+                except Exception:
+                    _LOG.warning("OTLP exporter unavailable; using console")
+            if exporter is None:
+                _PROVIDER.add_span_processor(
+                    BatchSpanProcessor(otlp or ConsoleSpanExporter()))
+            trace.set_tracer_provider(_PROVIDER)
+        if exporter is not None:
+            _PROVIDER.add_span_processor(SimpleSpanProcessor(exporter))
         _TRACER = trace.get_tracer("generativeaiexamples_tpu")
+        _BACKEND = "otel"
         _ENABLED = True
         return True
     except Exception:
-        _LOG.exception("tracing setup failed; disabled")
-        _ENABLED = False
-        return False
+        # otel SDK unavailable: built-in minimal tracer (real spans,
+        # W3C propagation, log/in-memory export).
+        if _TRACER is None or _BACKEND != "mini":
+            _TRACER = _MiniTracer()
+            _BACKEND = "mini"
+        if exporter is not None:
+            _TRACER.exporters.append(exporter)
+        elif not _TRACER.exporters:
+            _TRACER.exporters.append(LogExporter())
+        _ENABLED = True
+        _LOG.info("tracing enabled with built-in tracer (otel SDK absent)")
+        return True
 
 
 def extract_context(headers: Dict[str, str]):
@@ -70,6 +235,10 @@ def extract_context(headers: Dict[str, str]):
     tracing.py:62-73)."""
     if not _ENABLED:
         return None
+    if _BACKEND == "mini":
+        hdrs = {k.lower(): v for k, v in dict(headers).items()}
+        tp = hdrs.get("traceparent", "")
+        return _parse_traceparent(tp) if tp else None
     try:
         from opentelemetry.propagate import extract
 
@@ -81,14 +250,68 @@ def extract_context(headers: Dict[str, str]):
 def inject_context(headers: Dict[str, str]) -> Dict[str, str]:
     """Inject the current span context into outgoing headers (reference
     frontend/tracing.py:46-50)."""
-    if _ENABLED:
-        try:
-            from opentelemetry.propagate import inject
+    if not _ENABLED:
+        return headers
+    if _BACKEND == "mini":
+        ctx = getattr(_TLS, "ctx", None)
+        if ctx is not None:
+            headers["traceparent"] = (
+                f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-01")
+        return headers
+    try:
+        from opentelemetry.propagate import inject
 
-            inject(headers)
-        except Exception:
-            pass
+        inject(headers)
+    except Exception:
+        pass
     return headers
+
+
+def current_context():
+    """The active trace context in this thread (None when disabled) —
+    handed to GenRequest.trace_context so engine spans parent onto the
+    request trace across the scheduler-thread boundary."""
+    if not _ENABLED:
+        return None
+    if _BACKEND == "mini":
+        return getattr(_TLS, "ctx", None)
+    try:
+        from opentelemetry import context as otel_context
+
+        return otel_context.get_current()
+    except Exception:
+        return None
+
+
+def attach_context(ctx):
+    """Attach an extracted context to the current thread; returns a
+    detach token (None if disabled/no ctx)."""
+    if not _ENABLED or ctx is None:
+        return None
+    if _BACKEND == "mini":
+        prev = getattr(_TLS, "ctx", None)
+        _TLS.ctx = ctx
+        return ("mini", prev)
+    try:
+        from opentelemetry import context as otel_context
+
+        return otel_context.attach(ctx)
+    except Exception:
+        return None
+
+
+def detach_context(token) -> None:
+    if token is None:
+        return
+    if isinstance(token, tuple) and token and token[0] == "mini":
+        _TLS.ctx = token[1]
+        return
+    try:
+        from opentelemetry import context as otel_context
+
+        otel_context.detach(token)
+    except Exception:
+        pass
 
 
 @contextlib.contextmanager
@@ -112,19 +335,49 @@ class _NullSpan:
         pass
 
 
+class ManualSpan:
+    """Explicitly started/ended span for code that crosses threads (the
+    engine scheduler opens one at prefill and ends it at slot retire —
+    start_as_current_span's thread-local context doesn't fit there).
+    No-ops when tracing is disabled."""
+
+    def __init__(self, name: str, context=None,
+                 attributes: Optional[Dict] = None):
+        self._span = None
+        if _ENABLED and _TRACER is not None:
+            try:
+                self._span = _TRACER.start_span(name, context=context,
+                                                attributes=attributes or {})
+            except Exception:
+                self._span = None
+
+    def set_attribute(self, key: str, value) -> None:
+        if self._span is not None:
+            self._span.set_attribute(key, value)
+
+    def add_event(self, name: str, attributes: Optional[Dict] = None) -> None:
+        if self._span is not None:
+            self._span.add_event(name, attributes or {})
+
+    def end(self) -> None:
+        if self._span is not None:
+            self._span.end()
+            self._span = None
+
+
 class GenerationSpan:
     """Per-request span helper: records TTFT as an event on the first
-    token and token counts at the end."""
+    token and token counts at the end. Built on ManualSpan (not
+    thread-local "current span") so it is safe across asyncio task
+    interleaving and executor threads."""
 
     def __init__(self, name: str = "generate", context=None):
-        self._cm = span(name, context=context)
-        self.sp = None
+        self.sp = ManualSpan(name, context=context)
         self.t0 = time.perf_counter()
         self.first: Optional[float] = None
         self.tokens = 0
 
     def __enter__(self):
-        self.sp = self._cm.__enter__()
         return self
 
     def on_token(self):
@@ -138,4 +391,5 @@ class GenerationSpan:
         self.sp.set_attribute("tokens_generated", self.tokens)
         if self.first is not None:
             self.sp.set_attribute("ttft_ms", round(self.first * 1e3, 2))
-        return self._cm.__exit__(*exc)
+        self.sp.end()
+        return False

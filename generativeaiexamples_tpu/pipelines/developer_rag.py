@@ -45,7 +45,7 @@ class QAChatbot(BaseExample):
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:
-        results = self.res.retriever.retrieve(query)
+        results = self.res.retriever.retrieve_default(query)
         if not results:
             # Reference behavior: short-circuit when retrieval is empty
             # (developer_rag/chains.py:157-163).

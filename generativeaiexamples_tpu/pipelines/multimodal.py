@@ -108,7 +108,7 @@ class MultimodalRAG(QAChatbot):
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:
-        results = self.res.retriever.retrieve(query)
+        results = self.res.retriever.retrieve_default(query)
         if not results:
             yield ("No response generated from LLM, make sure your query is "
                    "relevant to the ingested document.")

@@ -29,8 +29,12 @@ class Resources:
         self.reranker = (reranker if reranker is not None
                          else factory.get_reranker(config))
         dim = getattr(self.embedder, "dim", config.embeddings.dimensions)
+        # The document store is durable when persist_dir is configured
+        # (loads existing data now, saves on every mutation); the
+        # conversation-memory store is always ephemeral.
         self.store = store if store is not None else create_vector_store(
-            config, dim=dim, mesh=mesh)
+            config, dim=dim, mesh=mesh,
+            persist_dir=config.vector_store.persist_dir)
         # second store for conversation memory (multi_turn_rag parity,
         # chains.py:45-58 `conv_store`)
         self.conv_store = conv_store if conv_store is not None else \
@@ -42,6 +46,11 @@ class Resources:
             score_threshold=config.retriever.score_threshold,
             max_context_tokens=config.retriever.max_context_tokens,
             reranker=self.reranker,
+            # ranked_hybrid becomes the default retrieval path when the
+            # config asks for it AND a reranker exists (fm-asr
+            # retriever.py:64 nr_pipeline semantics).
+            default_hybrid=(config.retriever.nr_pipeline == "ranked_hybrid"
+                            and self.reranker is not None),
         )
         self._lock = threading.Lock()
         self.extras: Dict = {}  # pipeline-private state (CSV registry etc.)

@@ -68,7 +68,8 @@ class Retriever:
     def __init__(self, store, embedder, *, top_k: int = 4,
                  score_threshold: Optional[float] = 0.25,
                  max_context_tokens: int = 1500,
-                 reranker=None, token_counter=None):
+                 reranker=None, token_counter=None,
+                 default_hybrid: bool = False):
         self.store = store
         self.embedder = embedder
         self.top_k = top_k
@@ -76,20 +77,36 @@ class Retriever:
         self.max_context_tokens = max_context_tokens
         self.reranker = reranker
         self.tk = token_counter or ApproxTokenizer()
+        # retriever.nr_pipeline == "ranked_hybrid" routes default
+        # retrieval through the hybrid path (dense ∪ BM25 + rerank).
+        self.default_hybrid = default_hybrid
 
     # -- core --------------------------------------------------------------
 
+    def retrieve_default(self, query: str, top_k: Optional[int] = None
+                         ) -> List[SearchResult]:
+        """The configured retrieval path: ranked_hybrid when enabled,
+        plain dense otherwise. Pipelines call this one."""
+        if self.default_hybrid:
+            return self.retrieve_hybrid(query, top_k=top_k)
+        return self.retrieve(query, top_k=top_k)
+
     def retrieve(self, query: str, top_k: Optional[int] = None,
                  with_threshold: bool = True) -> List[SearchResult]:
+        from generativeaiexamples_tpu.obs import tracing
+
         k = top_k or self.top_k
-        qv = self.embedder.embed_query(query)
-        results = self.store.search(
-            qv, top_k=k,
-            score_threshold=self.score_threshold if with_threshold else None)
-        if not results and with_threshold:
-            # Reference fallback: retry without score threshold
-            # (multi_turn_rag/chains.py:189-219).
-            results = self.store.search(qv, top_k=k, score_threshold=None)
+        with tracing.span("retriever.retrieve", {"top_k": k}) as sp:
+            qv = self.embedder.embed_query(query)
+            results = self.store.search(
+                qv, top_k=k,
+                score_threshold=self.score_threshold if with_threshold
+                else None)
+            if not results and with_threshold:
+                # Reference fallback: retry without score threshold
+                # (multi_turn_rag/chains.py:189-219).
+                results = self.store.search(qv, top_k=k, score_threshold=None)
+            sp.set_attribute("n_results", len(results))
         return results
 
     def retrieve_hybrid(self, query: str, top_k: Optional[int] = None,
@@ -140,7 +157,9 @@ class Retriever:
             out.append(r)
         return out
 
-    def context(self, query: str, hybrid: bool = False) -> str:
+    def context(self, query: str, hybrid: Optional[bool] = None) -> str:
+        if hybrid is None:
+            hybrid = self.default_hybrid
         results = (self.retrieve_hybrid(query) if hybrid
                    else self.retrieve(query))
         results = self.limit_tokens(results)

@@ -38,14 +38,23 @@ class SearchResult:
 
 
 class MemoryVectorStore:
-    """Exact cosine/IP search over an [N, D] matrix. Thread-safe."""
+    """Exact cosine/IP search over an [N, D] matrix. Thread-safe.
 
-    def __init__(self, dim: int, metric: str = "ip"):
+    With `persist_dir` set, the store is durable: existing data is
+    loaded at construction and every mutation (add / delete) writes the
+    snapshot back — the reference's "ingested data persists across
+    sessions" feature (CHANGELOG.md:63, vector-DB volumes)."""
+
+    def __init__(self, dim: int, metric: str = "ip",
+                 persist_dir: Optional[str] = None):
         self.dim = dim
         self.metric = metric  # "ip" (normalized embeddings) or "cosine"
         self._vecs = np.zeros((0, dim), np.float32)
         self._docs: List[Dict] = []
         self._lock = threading.RLock()
+        self.persist_dir = persist_dir or None
+        if self.persist_dir:
+            self._load_from(self.persist_dir)
 
     # -- ingest ------------------------------------------------------------
 
@@ -60,6 +69,7 @@ class MemoryVectorStore:
             for t, m in zip(texts, metadatas):
                 self._docs.append({"text": t, "metadata": dict(m)})
             self._on_update()
+            self._persist()
             return list(range(base, base + len(texts)))
 
     # -- search ------------------------------------------------------------
@@ -108,6 +118,7 @@ class MemoryVectorStore:
                 (0, self.dim), np.float32)
             self._docs = [self._docs[i] for i in keep]
             self._on_update()
+            self._persist()
             return removed
 
     def __len__(self) -> int:
@@ -133,14 +144,21 @@ class MemoryVectorStore:
     @classmethod
     def load(cls, path: str, dim: int, metric: str = "ip"):
         store = cls(dim, metric)
+        store._load_from(path)
+        return store
+
+    def _load_from(self, path: str) -> None:
         vp = os.path.join(path, "vectors.npz")
         dp = os.path.join(path, "docs.jsonl")
         if os.path.isfile(vp) and os.path.isfile(dp):
-            store._vecs = np.load(vp)["vecs"].astype(np.float32)
+            self._vecs = np.load(vp)["vecs"].astype(np.float32)
             with open(dp) as fh:
-                store._docs = [json.loads(ln) for ln in fh if ln.strip()]
-            store._on_update()
-        return store
+                self._docs = [json.loads(ln) for ln in fh if ln.strip()]
+            self._on_update()
+
+    def _persist(self) -> None:
+        if self.persist_dir:
+            self.save(self.persist_dir)
 
     def _on_update(self) -> None:
         pass  # hook for device-side mirrors
@@ -151,12 +169,13 @@ class TPUVectorStore(MemoryVectorStore):
     is refreshed lazily after mutations (ingest batches, then search)."""
 
     def __init__(self, dim: int, metric: str = "ip", mesh=None,
-                 shard_axis: str = "tensor"):
+                 shard_axis: str = "tensor",
+                 persist_dir: Optional[str] = None):
         self.mesh = mesh
         self.shard_axis = shard_axis
         self._device_index = None
         self._dirty = True
-        super().__init__(dim, metric)
+        super().__init__(dim, metric, persist_dir=persist_dir)
 
     def _on_update(self) -> None:
         self._dirty = True
@@ -206,10 +225,14 @@ class TPUVectorStore(MemoryVectorStore):
             return out
 
 
-def create_vector_store(config, dim: Optional[int] = None, mesh=None):
+def create_vector_store(config, dim: Optional[int] = None, mesh=None,
+                        persist_dir: Optional[str] = None):
     """Factory from AppConfig.vector_store (parity: utils.py:158-243).
     name: memory | tpu (in-process) — milvus/pgvector configs map to the
-    in-process stores with a warning when their client libs are absent."""
+    in-process stores with a warning when their client libs are absent.
+    `persist_dir` (usually config.vector_store.persist_dir) makes the
+    store durable; pass None for ephemeral stores (conversation
+    memory)."""
     import logging
 
     name = config.vector_store.name
@@ -220,5 +243,5 @@ def create_vector_store(config, dim: Optional[int] = None, mesh=None):
             "in-process TPU-MIPS store (same API surface)", name)
         name = "tpu"
     if name in ("tpu", "native"):
-        return TPUVectorStore(dim, mesh=mesh)
-    return MemoryVectorStore(dim)
+        return TPUVectorStore(dim, mesh=mesh, persist_dir=persist_dir)
+    return MemoryVectorStore(dim, persist_dir=persist_dir)

@@ -188,7 +188,18 @@ class OpenAIServer:
         req = self._gen_request(body, chat)
         stops = self._stop_strings(body)
         stream = bool(body.get("stream"))
-        self.llm.submit(req)
+        from generativeaiexamples_tpu.serving.engine import PromptTooLongError
+
+        try:
+            self.llm.submit(req)
+        except PromptTooLongError as e:
+            # OpenAI-style context-length rejection at the API boundary
+            # (no silent truncation; reference rejects at server.py:63,85).
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error",
+                           "code": "context_length_exceeded"}},
+                status=422)
         created = int(time.time())
         obj = "chat.completion.chunk" if chat else "text_completion"
 

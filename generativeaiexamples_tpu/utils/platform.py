@@ -23,3 +23,34 @@ def apply_platform_env() -> None:
             jax.config.update("jax_platforms", want)
     except Exception:
         pass
+
+
+_COMPILE_CACHE_SET = False
+
+
+def setup_compile_cache(cache_dir: str) -> bool:
+    """Enable JAX's persistent compilation cache (engine startup cost is
+    real: bench r01 showed ~800 s param build + first compiles). Idempotent;
+    returns whether the cache is active."""
+    global _COMPILE_CACHE_SET
+    if not cache_dir:
+        return False
+    if _COMPILE_CACHE_SET:
+        return True
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache everything that took meaningful compile time; the decode
+        # graph is the one that matters and compiles in seconds.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _COMPILE_CACHE_SET = True
+        return True
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).exception(
+            "persistent compile cache setup failed (dir=%s)", cache_dir)
+        return False

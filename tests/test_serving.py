@@ -141,9 +141,15 @@ class TestEngine:
         assert snap["tokens_generated"] > 0
         assert snap["ttft_p50_ms"] is not None
 
-    def test_long_prompt_truncated_not_crashing(self, tiny_engine):
+    def test_long_prompt_rejected_at_submit(self, tiny_engine):
+        from generativeaiexamples_tpu.serving.engine import PromptTooLongError
+
         prompt = list(range(5)) * 20  # 100 > max bucket 32
-        events = list(tiny_engine.generate_stream(prompt, max_new_tokens=3))
+        with pytest.raises(PromptTooLongError):
+            list(tiny_engine.generate_stream(prompt, max_new_tokens=3))
+        # explicit opt-in truncation still works (context-budget mode)
+        events = list(tiny_engine.generate_stream(
+            prompt, max_new_tokens=3, truncate_prompt=True))
         assert events[-1]["finished"]
 
 
