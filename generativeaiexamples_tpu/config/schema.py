@@ -172,6 +172,10 @@ class EngineConfig:
     page_size: int = 128  # KV-cache page (tokens per page)
     prefill_buckets: Tuple[int, ...] = (128, 512, 1024, 2048, 4096)
     decode_steps_per_dispatch: int = 8
+    # Decode dispatch pipeline depth: blocks enqueued ahead of the host
+    # fetch so device compute overlaps result readback (readback latency
+    # is ~100 ms through the axon tunnel). 1 = synchronous (old behavior).
+    pipeline_depth: int = 2
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
 

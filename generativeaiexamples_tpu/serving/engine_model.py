@@ -115,6 +115,77 @@ def prefill_step(
     return logits, PagePool(k, v, ps)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas",
+                                             "sampling_flags"),
+                   donate_argnames=("pool",))
+def prefill_batch_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    tokens: jax.Array,       # [N, S_bucket]
+    lengths: jax.Array,      # [N] valid prompt tokens (padding rows: 1)
+    table_rows: jax.Array,   # [N, S_bucket // page_size] (padding: page 0)
+    temperature: jax.Array,  # [N]
+    top_p: jax.Array,        # [N]
+    top_k: jax.Array,        # [N]
+    key: jax.Array,
+    use_pallas: Optional[bool] = None,
+    sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
+) -> Tuple[jax.Array, PagePool]:
+    """Prefill N sequences in ONE dispatch and sample each one's first
+    token on device. Under burst admission this reads the weights once
+    for the whole group instead of once per request — prefill at S=128
+    is weight-bandwidth-bound (~7 GB int8), so N admissions cost barely
+    more than one. Returns (first tokens [N], pool).
+
+    Padding rows (lengths=1, table page 0) are computed and their k/v
+    land in the sink page; their sampled tokens are ignored by the
+    caller. Compiles per (N_bucket, S_bucket)."""
+    from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
+
+    N, S = tokens.shape
+    ps = pool.page_size
+    npages = S // ps
+    KH, Hd = cfg.n_kv_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
+
+    x = params["tok_emb"][tokens].astype(cfg.dtype)
+
+    def body(x, w):
+        h = rms_norm(x, w["ln1"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, h, w, positions)
+        out = attn_ops.attention(q, k, v, causal=True, lengths=lengths,
+                                 use_pallas=use_pallas)
+        x = _finish_block(cfg, x, out, w)
+        # [N, KH, S, Hd] -> [N, S, KH, Hd]
+        return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+
+    x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
+    # [L, N, S, KH, Hd] -> [L, N, npages, KH, ps, Hd] -> one scatter
+    L = k_stack.shape[0]
+    kw = k_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
+    vw = v_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
+    li = jnp.arange(L)[:, None, None]
+    k = pool.k.at[li, table_rows[None, :, :]].set(kw.astype(pool.k.dtype))
+    v = pool.v.at[li, table_rows[None, :, :]].set(vw.astype(pool.v.dtype))
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
+    logits = _logits(cfg, params, last)[:, 0]  # [N, V]
+    all_greedy, any_top_k, any_top_p = sampling_flags
+    sp = SamplingParams(temperature, top_p, top_k)
+    toks = sample(logits, sp, key, all_greedy=all_greedy,
+                  any_top_k=any_top_k, any_top_p=any_top_p)
+    return toks, PagePool(k, v, ps)
+
+
+@functools.partial(jax.jit, donate_argnames=("last_tokens",))
+def set_last_tokens(last_tokens: jax.Array, idxs: jax.Array,
+                    toks: jax.Array) -> jax.Array:
+    """last_tokens[idxs] = toks on device (batched admission). Padding
+    rows carry an out-of-bounds index and are dropped, so the arrays
+    stay power-of-two padded (one compile per N bucket, not per n)."""
+    return last_tokens.at[idxs].set(toks.astype(last_tokens.dtype),
+                                    mode="drop")
+
+
 import os
 
 # Layer-loop strategy for the decode step. Unrolled (default) lets XLA
@@ -191,7 +262,7 @@ def decode_step(
                    donate_argnames=("pool",))
 def decode_multi_step(
     params, cfg: LlamaConfig, pool: PagePool,
-    tokens: jax.Array,        # [B]
+    last_tokens: jax.Array,   # [B] DEVICE-RESIDENT current token per slot
     page_tables: jax.Array,   # [B, maxp]
     lengths: jax.Array,       # [B] incl. current token
     active: jax.Array,        # [B] bool — inactive slots don't advance
@@ -202,19 +273,27 @@ def decode_multi_step(
     n_steps: int,
     use_pallas: Optional[bool] = None,
     sampling_flags: Tuple[bool, bool, bool] = (False, True, True),
-) -> Tuple[jax.Array, PagePool]:
-    """n_steps fused decode iterations with ON-DEVICE sampling — one
-    dispatch instead of n (amortizes host/dispatch overhead, the
-    dominant cost of single-step decoding at small batch). Sequences
-    must have page capacity for n_steps more tokens (caller ensures).
-    Returns (sampled tokens [B, n_steps], pool)."""
+) -> Tuple[jax.Array, jax.Array, PagePool]:
+    """n_steps fused decode iterations with ON-DEVICE sampling and
+    device-side token chaining: `last_tokens` lives on device and flows
+    dispatch-to-dispatch, so the host never has to read a sampled token
+    before launching the next block — the scheduler overlaps the
+    high-latency host fetch of block N with the device computing block
+    N+1 (through the axon tunnel a host sync costs ~100 ms; this is the
+    dominant decode cost, not FLOPs).
+
+    Returns (block [B, n_steps+1], last_tokens_out [B], pool), where
+    block[:, 0] echoes the input tokens (the not-yet-emitted first token
+    of a newly admitted slot) and block[:, 1:] are the sampled tokens.
+    Sequences must have page capacity for n_steps more tokens."""
     from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
 
-    B = tokens.shape[0]
+    B = last_tokens.shape[0]
     ps = pool.page_size
     sp = SamplingParams(temperature, top_p, top_k)
     all_greedy, any_top_k, any_top_p = sampling_flags
-    out_tokens = []
+    tokens = last_tokens
+    out_tokens = [tokens]
     for i in range(n_steps):
         logits, k_stack, v_stack = _decode_once(
             params, cfg, pool, tokens, page_tables, lengths, use_pallas)
@@ -227,4 +306,28 @@ def decode_multi_step(
         tokens = jnp.where(active, nxt, tokens)
         out_tokens.append(tokens)
         lengths = jnp.where(active, lengths + 1, lengths)
-    return jnp.stack(out_tokens, axis=1), pool
+    return jnp.stack(out_tokens, axis=1), tokens, pool
+
+
+@functools.partial(jax.jit, static_argnames=("all_greedy", "any_top_k",
+                                             "any_top_p"))
+def sample_token(logits: jax.Array, temperature, top_p, top_k, key,
+                 all_greedy: bool = True, any_top_k: bool = False,
+                 any_top_p: bool = False) -> jax.Array:
+    """Sample ONE token from [V] logits on device (no host fetch) — the
+    prefill path's sampler; the result feeds set_last_token and reaches
+    the host only with the next decode block's fetch."""
+    from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
+
+    sp = SamplingParams(jnp.full((1,), temperature, jnp.float32),
+                        jnp.full((1,), top_p, jnp.float32),
+                        jnp.full((1,), top_k, jnp.int32))
+    return sample(logits[None, :], sp, key, all_greedy=all_greedy,
+                  any_top_k=any_top_k, any_top_p=any_top_p)[0]
+
+
+@functools.partial(jax.jit, donate_argnames=("last_tokens",))
+def set_last_token(last_tokens: jax.Array, idx: jax.Array,
+                   tok: jax.Array) -> jax.Array:
+    """last_tokens[idx] = tok, on device (admission after prefill)."""
+    return last_tokens.at[idx].set(tok.astype(last_tokens.dtype))

@@ -1,0 +1,50 @@
+"""Build random llama params directly ON DEVICE (no host transfer).
+
+The axon TPU tunnel moves host->device bulk data at ~10 MB/s (bench r01
+spent 797 s transferring 8 GB of int8 weights). Throughput benchmarks
+are weight-value-independent, so generating weights on device with
+jax.random removes that cost entirely.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops.quant import QuantizedTensor
+
+
+def build_params_on_device(cfg: llama.LlamaConfig, quantize: bool):
+    D, H, KH, Hd, M, L, V = (cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                             cfg.head_dim, cfg.mlp_dim, cfg.n_layers,
+                             cfg.vocab_size)
+    key = jax.random.PRNGKey(0)
+
+    def w(*shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        if quantize:
+            q = jax.jit(lambda k: jax.random.randint(
+                k, shape, -127, 128, jnp.int8))(key)
+            s = jnp.full(shape[:-2] + shape[-1:], scale / 127.0, jnp.float32)
+            return QuantizedTensor(q, s)
+        return jax.jit(lambda k: (jax.random.normal(k, shape, jnp.float32)
+                                  * scale).astype(jnp.bfloat16))(key)
+
+    def vec(*shape):
+        return jnp.ones(shape, jnp.bfloat16)
+
+    params = {
+        "tok_emb": jax.jit(lambda k: (jax.random.normal(
+            k, (V, D), jnp.float32) * 0.02).astype(jnp.bfloat16))(key),
+        "ln_f": vec(D),
+        "layers": {
+            "ln1": vec(L, D), "ln2": vec(L, D),
+            "wq": w(L, D, H * Hd), "wk": w(L, D, KH * Hd),
+            "wv": w(L, D, KH * Hd), "wo": w(L, H * Hd, D),
+            "w_gate": w(L, D, M), "w_up": w(L, D, M), "w_down": w(L, M, D),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(D, V, scale=D ** -0.5)
+    return params

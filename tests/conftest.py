@@ -43,3 +43,11 @@ def default_config():
     from generativeaiexamples_tpu.config import AppConfig
 
     return AppConfig()
+
+
+# The persistent XLA compile cache must not leak between machines (the
+# axon TPU host writes CPU AOT entries that can SIGILL this host) or
+# between test runs — force it off for the whole suite.
+from generativeaiexamples_tpu.utils import platform as _plat  # noqa: E402
+
+_plat._COMPILE_CACHE_SET = True
