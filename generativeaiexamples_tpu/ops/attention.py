@@ -256,13 +256,35 @@ def on_tpu() -> bool:
 
 def attention(
     q, k, v, *, causal=True, lengths=None, q_offset=None, scale=None,
-    use_pallas: Optional[bool] = None,
+    use_pallas: Optional[bool] = None, mesh=None, interpret: bool = False,
 ):
-    """Dispatch: Pallas flash kernel on TPU, XLA reference elsewhere."""
+    """Dispatch: Pallas flash kernel on TPU, XLA reference elsewhere.
+
+    With a multi-device `mesh`, the Pallas kernel is wrapped in a
+    shard_map over the "tensor" axis — attention is head-parallel under
+    the Megatron layout (q heads and kv heads both sharded on tensor),
+    so each chip runs the kernel on its local heads with no collectives.
+    The XLA reference path needs no wrapping: GSPMD partitions it.
+    """
     use_pallas = on_tpu() if use_pallas is None else use_pallas
     S = q.shape[2]
     if use_pallas and pltpu is not None and q_offset is None and S % 128 == 0:
-        return flash_attention(q, k, v, causal=causal, lengths=lengths, scale=scale)
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            B = q.shape[0]
+            ln = lengths if lengths is not None else jnp.full((B,), S, jnp.int32)
+            hs = P(None, "tensor", None, None)
+            fn = shard_map(
+                lambda q_, k_, v_, ln_: flash_attention(
+                    q_, k_, v_, causal=causal, lengths=ln_, scale=scale,
+                    interpret=interpret),
+                mesh=mesh, in_specs=(hs, hs, hs, P()), out_specs=hs,
+                check_rep=False)
+            return fn(q, k, v, ln)
+        return flash_attention(q, k, v, causal=causal, lengths=lengths,
+                               scale=scale, interpret=interpret)
     return mha_reference(
         q, k, v, causal=causal, lengths=lengths, q_offset=q_offset, scale=scale
     )

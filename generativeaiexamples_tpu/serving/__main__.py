@@ -27,15 +27,30 @@ import jax  # noqa: E402
 def build_engines(cfg, model_size: str = "tiny"):
     from generativeaiexamples_tpu.models import bert, llama
     from generativeaiexamples_tpu.ops.quant import quantize_llama_params
+    from generativeaiexamples_tpu.parallel.mesh import (
+        build_mesh, maybe_initialize_distributed)
+    from generativeaiexamples_tpu.serving import sharding as shd
     from generativeaiexamples_tpu.serving.encoders import (
         EmbeddingEngine, RerankEngine)
     from generativeaiexamples_tpu.serving.engine import LLMEngine
     from generativeaiexamples_tpu.utils.tokenizer import load_tokenizer
 
-    if cfg.engine.weights_path:
-        from generativeaiexamples_tpu.models.hf_loader import load_llama
+    maybe_initialize_distributed()
+    # Multi-chip: build the mesh from config (default MeshConfig puts all
+    # devices on the tensor axis — TP serving, the NIM INFERENCE_GPU_COUNT
+    # replacement) and shard params + KV pool over it.
+    mesh = build_mesh(cfg.mesh) if len(jax.devices()) > 1 else None
 
-        params, lcfg = load_llama(cfg.engine.weights_path)
+    if cfg.engine.weights_path:
+        from generativeaiexamples_tpu.models.hf_loader import (
+            llama_config_from_hf, load_llama)
+
+        lcfg = llama_config_from_hf(cfg.engine.weights_path)
+        if mesh is not None:
+            mesh = shd.compatible_mesh(lcfg, mesh)
+        params, lcfg = load_llama(
+            cfg.engine.weights_path, cfg=lcfg, mesh=mesh,
+            quantize=cfg.engine.quantize_weights == "int8")
         tokenizer = load_tokenizer(cfg.engine.weights_path)
     else:
         geometry = {
@@ -50,10 +65,15 @@ def build_engines(cfg, model_size: str = "tiny"):
         params = llama.init_params(lcfg, jax.random.PRNGKey(0))
         tokenizer = load_tokenizer("byte")
 
-    if cfg.engine.quantize_weights == "int8":
-        params = quantize_llama_params(params)
+    if cfg.engine.quantize_weights == "int8" and not cfg.engine.weights_path:
+        params = quantize_llama_params(params)  # loader handles the rest
+    if mesh is not None:
+        mesh = shd.compatible_mesh(lcfg, mesh)
+        logging.info("sharding llama params over mesh %s", dict(mesh.shape))
+        if not cfg.engine.weights_path:  # loader already placed real weights
+            params = shd.shard_llama_params(params, lcfg, mesh)
 
-    llm = LLMEngine(params, lcfg, tokenizer, cfg.engine).start()
+    llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh).start()
 
     hermetic = not cfg.engine.weights_path
     # Encoders: real weights come from their OWN snapshots + tokenizers

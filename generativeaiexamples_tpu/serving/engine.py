@@ -121,6 +121,8 @@ class EngineMetrics:
             self.ttft_ms.append(ms)
 
     def record_tokens(self, n: int) -> None:
+        if n <= 0:
+            return
         with self._lock:
             self._token_events.append((time.perf_counter(), n))
 
@@ -156,17 +158,35 @@ class EngineMetrics:
 
 
 class LLMEngine:
-    """Single-host engine over one jax device (or a mesh-replicated jit —
-    multi-chip sharding is applied to params/pool by the caller)."""
+    """Single-host engine over one jax device, or tensor-parallel over a
+    device mesh.
+
+    With `mesh`: params must already be placed with
+    serving.sharding.shard_llama_params (Megatron TP layout); the KV
+    page pool and the device-resident token buffer are sharded/
+    replicated here, and every jitted step runs under GSPMD — XLA
+    inserts the TP all-reduces over ICI. This replaces the reference's
+    hidden NIM tensor parallelism (compose.env:17-18
+    INFERENCE_GPU_COUNT) with in-repo, inspectable sharding.
+    """
 
     def __init__(self, params, cfg: LlamaConfig, tokenizer,
                  engine_cfg: Optional[EngineConfig] = None,
-                 n_pages: Optional[int] = None, use_pallas: Optional[bool] = None):
+                 n_pages: Optional[int] = None, use_pallas: Optional[bool] = None,
+                 mesh=None):
+        from generativeaiexamples_tpu.serving import sharding as shd
+
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.ecfg = engine_cfg or EngineConfig()
         self.use_pallas = use_pallas
+        self.mesh = mesh if shd.is_sharded(mesh) else None
+        if self.mesh is not None:
+            shd.validate_tp(cfg, self.mesh)
+            self._replicated = shd.replicated(self.mesh)
+        else:
+            self._replicated = None
         if self.ecfg.compile_cache_dir:
             from generativeaiexamples_tpu.utils.platform import (
                 setup_compile_cache)
@@ -181,6 +201,10 @@ class LLMEngine:
             n_pages = self.ecfg.max_batch_size * self.max_pages + 1
         self.pool = PagePool.zeros(cfg, n_pages, ps,
                                    dtype=jnp.dtype(self.ecfg.kv_dtype))
+        if self.mesh is not None:
+            from generativeaiexamples_tpu.serving import sharding as shd
+
+            self.pool = shd.shard_pool(self.pool, self.mesh)
         self.allocator = PageAllocator(n_pages)
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()
@@ -200,6 +224,10 @@ class LLMEngine:
         # Device-resident current token per slot (decode blocks chain
         # through it; the host only reads tokens one block behind).
         self._last_tokens = jnp.zeros((self.ecfg.max_batch_size,), jnp.int32)
+        if self._replicated is not None:
+            self._rng = jax.device_put(self._rng, self._replicated)
+            self._last_tokens = jax.device_put(self._last_tokens,
+                                               self._replicated)
         self._inflight: deque = deque()
         self.pipeline_depth = max(1, self.ecfg.pipeline_depth)
 
@@ -253,6 +281,14 @@ class LLMEngine:
             if s is None:
                 return i
         return None
+
+    def _put(self, x):
+        """Host array -> device. Under a mesh, explicitly replicated so
+        jit never sees an input committed to a single device of a
+        multi-device computation."""
+        if self._replicated is not None:
+            return jax.device_put(np.asarray(x), self._replicated)
+        return jnp.asarray(x)
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -392,14 +428,14 @@ class LLMEngine:
         all_greedy = bool(all(temps[:n] <= 0.0))
         flags = (True, False, False) if all_greedy else (False, True, True)
         toks, self.pool = engine_model.prefill_batch_step(
-            self.params, self.cfg, self.pool, jnp.asarray(tokens),
-            jnp.asarray(lengths), jnp.asarray(rows), jnp.asarray(temps),
-            jnp.asarray(top_ps), jnp.asarray(top_ks), self._next_key(),
-            self.use_pallas, sampling_flags=flags)
+            self.params, self.cfg, self.pool, self._put(tokens),
+            self._put(lengths), self._put(rows), self._put(temps),
+            self._put(top_ps), self._put(top_ks), self._next_key(),
+            self.use_pallas, sampling_flags=flags, mesh=self.mesh)
         # Scatter the first-tokens into the device buffer (padding rows'
         # out-of-bounds indices are dropped on device).
         self._last_tokens = engine_model.set_last_tokens(
-            self._last_tokens, jnp.asarray(idxs), toks)
+            self._last_tokens, self._put(idxs), toks)
         for req, slot_idx, seq, ids in entries:
             span = ManualSpan("engine.generate", context=req.trace_context,
                               attributes={"prompt_tokens": len(ids),
@@ -500,10 +536,11 @@ class LLMEngine:
         flags = (True, False, False) if all_greedy else (False, True, True)
         block, self._last_tokens, self.pool = engine_model.decode_multi_step(
             self.params, self.cfg, self.pool, self._last_tokens,
-            jnp.asarray(tables), jnp.asarray(lengths),
-            jnp.asarray(active_mask), jnp.asarray(temps),
-            jnp.asarray(top_ps), jnp.asarray(top_ks),
-            self._next_key(), K, self.use_pallas, sampling_flags=flags)
+            self._put(tables), self._put(lengths),
+            self._put(active_mask), self._put(temps),
+            self._put(top_ps), self._put(top_ks),
+            self._next_key(), K, self.use_pallas, sampling_flags=flags,
+            mesh=self.mesh)
         metas = []
         for i in active:
             s = self.slots[i]
@@ -540,9 +577,21 @@ class LLMEngine:
 
     def _process_block(self, fl: _InFlight) -> None:
         """Fetch one decode block's tokens (the only blocking host<->
-        device sync in the engine) and emit/finish slots from it."""
+        device sync in the engine) and emit/finish slots from it.
+        Pages parked on this block are released even if the fetch fails —
+        a device error must not leak them (they back retired slots that
+        may still be written to by this very block)."""
+        try:
+            self._process_block_inner(fl)
+        finally:
+            for seq in fl.releases:
+                seq.release()
+            fl.releases = []
+
+    def _process_block_inner(self, fl: _InFlight) -> None:
         block = np.asarray(fl.block)  # [B, K+1]; waits for the device
         now = time.perf_counter()
+        tokens_before = self.metrics.tokens_out
         for i, slot, first_col in fl.metas:
             if self.slots[i] is not slot:
                 continue  # retired while this block was in flight
@@ -560,12 +609,10 @@ class LLMEngine:
                 self._emit(slot, tok, slot_idx=i)
                 if self.slots[i] is not slot:
                     break  # finished mid-block; rest is overshoot
-        for seq in fl.releases:
-            seq.release()
+        self.metrics.record_tokens(self.metrics.tokens_out - tokens_before)
 
-    def _emit(self, slot: _Slot, tok: int, slot_idx: Optional[int] = None) -> None:
+    def _emit(self, slot: _Slot, tok: int, slot_idx: int) -> None:
         self.metrics.tokens_out += 1
-        self.metrics.record_tokens(1)
         slot.generated += 1
         eos_ids = getattr(self.tokenizer, "eos_ids", None) or \
             {getattr(self.tokenizer, "eos_id", None)}
@@ -579,15 +626,7 @@ class LLMEngine:
             "finish_reason": reason,
         })
         if finished:
-            # Find our slot index (prefill emits before slot placement).
-            if slot_idx is None:
-                slot_idx = next((j for j, s in enumerate(self.slots) if s is slot),
-                                None)
-            if slot_idx is not None:
-                self._finish(slot_idx, reason or "stop", emit=False)
-            else:
-                self._release_seq(slot.seq)
-                self._mark_done(slot)
+            self._finish(slot_idx, reason or "stop", emit=False)
 
     def _release_seq(self, seq: SequencePages) -> None:
         """Free a retired sequence's pages — deferred until the newest

@@ -69,7 +69,7 @@ def _write_pages_all_layers(pool: PagePool, k_stack, v_stack, page_idx, offset
     return PagePool(k, v, pool.page_size)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas"),
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),
                    donate_argnames=("pool",))
 def prefill_step(
     params, cfg: LlamaConfig, pool: PagePool,
@@ -77,6 +77,7 @@ def prefill_step(
     length: jax.Array,      # [] valid prompt tokens
     table_row: jax.Array,   # [S_bucket // page_size] page ids (0-padded)
     use_pallas: Optional[bool] = None,
+    mesh=None,
 ) -> Tuple[jax.Array, PagePool]:
     """Prefill one sequence; returns (last-token logits [V], pool).
 
@@ -97,7 +98,7 @@ def prefill_step(
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)
         out = attn_ops.attention(q, k, v, causal=True, lengths=lengths,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, mesh=mesh)
         x = _finish_block(cfg, x, out, w)
         return x, (k[0].transpose(1, 0, 2), v[0].transpose(1, 0, 2))  # [S,KH,Hd]
 
@@ -116,7 +117,7 @@ def prefill_step(
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas",
-                                             "sampling_flags"),
+                                             "sampling_flags", "mesh"),
                    donate_argnames=("pool",))
 def prefill_batch_step(
     params, cfg: LlamaConfig, pool: PagePool,
@@ -129,6 +130,7 @@ def prefill_batch_step(
     key: jax.Array,
     use_pallas: Optional[bool] = None,
     sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
+    mesh=None,
 ) -> Tuple[jax.Array, PagePool]:
     """Prefill N sequences in ONE dispatch and sample each one's first
     token on device. Under burst admission this reads the weights once
@@ -153,7 +155,7 @@ def prefill_batch_step(
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)
         out = attn_ops.attention(q, k, v, causal=True, lengths=lengths,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, mesh=mesh)
         x = _finish_block(cfg, x, out, w)
         # [N, KH, S, Hd] -> [N, S, KH, Hd]
         return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
@@ -197,7 +199,7 @@ _UNROLL_DECODE = os.environ.get("ENGINE_UNROLL_DECODE", "1") != "0"
 
 
 def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
-                 lengths, use_pallas):
+                 lengths, use_pallas, mesh=None):
     """One decode iteration: logits + the new k/v stacks (pool untouched)."""
     B = tokens.shape[0]
     positions = (lengths - 1)[:, None]  # [B, 1]
@@ -211,7 +213,7 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
         k_new, v_new = k[:, :, 0, :], v[:, :, 0, :]
         out = paged_attention_with_new(
             q[:, :, 0, :], kp, vp, page_tables, lengths, k_new, v_new,
-            use_pallas=use_pallas)
+            use_pallas=use_pallas, mesh=mesh)
         x = _finish_block(cfg, x, out[:, :, None, :], w)
         return x, (k_new, v_new)
 
@@ -237,7 +239,7 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
     return _logits(cfg, params, x)[:, 0], k_stack, v_stack
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas"),
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),
                    donate_argnames=("pool",))
 def decode_step(
     params, cfg: LlamaConfig, pool: PagePool,
@@ -245,6 +247,7 @@ def decode_step(
     page_tables: jax.Array,  # [B, maxp]
     lengths: jax.Array,      # [B] tokens incl. the one being generated NOW
     use_pallas: Optional[bool] = None,
+    mesh=None,
 ) -> Tuple[jax.Array, PagePool]:
     """One decode step for the whole slot batch -> (logits [B, V], pool)."""
     B = tokens.shape[0]
@@ -252,13 +255,13 @@ def decode_step(
     page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]  # [B]
     offset = (lengths - 1) % ps  # [B]
     logits, k_stack, v_stack = _decode_once(
-        params, cfg, pool, tokens, page_tables, lengths, use_pallas)
+        params, cfg, pool, tokens, page_tables, lengths, use_pallas, mesh)
     pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)
     return logits, pool
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas",
-                                             "sampling_flags"),
+                                             "sampling_flags", "mesh"),
                    donate_argnames=("pool",))
 def decode_multi_step(
     params, cfg: LlamaConfig, pool: PagePool,
@@ -273,6 +276,7 @@ def decode_multi_step(
     n_steps: int,
     use_pallas: Optional[bool] = None,
     sampling_flags: Tuple[bool, bool, bool] = (False, True, True),
+    mesh=None,
 ) -> Tuple[jax.Array, jax.Array, PagePool]:
     """n_steps fused decode iterations with ON-DEVICE sampling and
     device-side token chaining: `last_tokens` lives on device and flows
@@ -296,7 +300,7 @@ def decode_multi_step(
     out_tokens = [tokens]
     for i in range(n_steps):
         logits, k_stack, v_stack = _decode_once(
-            params, cfg, pool, tokens, page_tables, lengths, use_pallas)
+            params, cfg, pool, tokens, page_tables, lengths, use_pallas, mesh)
         page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]
         offset = (lengths - 1) % ps
         pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)

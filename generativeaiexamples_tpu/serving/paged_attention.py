@@ -199,7 +199,7 @@ def paged_attention_with_new(
     k_new: jax.Array,        # [B, KH, Hd] current-token key
     v_new: jax.Array,
     *, scale: Optional[float] = None, use_pallas: Optional[bool] = None,
-    interpret: bool = False,
+    interpret: bool = False, mesh=None,
 ) -> jax.Array:
     """Decode attention where the current token's k/v have NOT been
     written to the pool yet. This keeps the page pool read-only inside
@@ -214,6 +214,26 @@ def paged_attention_with_new(
     old = lengths - 1  # tokens actually in the pool
     use_pallas = (jax.default_backend() == "tpu") if use_pallas is None \
         else use_pallas
+
+    if use_pallas and pltpu is not None and mesh is not None \
+            and mesh.shape.get("tensor", 1) > 1:
+        # TP: heads and kv-pages are both sharded on the tensor axis
+        # (Megatron layout), so paged decode attention is embarrassingly
+        # head-parallel — shard_map runs the kernel per chip on its local
+        # heads/pages slice; page tables and lengths are replicated.
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        hs = P(None, "tensor", None)
+        pool_s = P(None, "tensor", None, None)
+        fn = shard_map(
+            lambda q_, kp_, vp_, t_, ln_, kn_, vn_: paged_attention_with_new(
+                q_, kp_, vp_, t_, ln_, kn_, vn_, scale=scale,
+                use_pallas=True, interpret=interpret),
+            mesh=mesh,
+            in_specs=(hs, pool_s, pool_s, P(), P(), hs, hs),
+            out_specs=hs, check_rep=False)
+        return fn(q, k_pages, v_pages, page_table, lengths, k_new, v_new)
 
     if use_pallas and pltpu is not None:
         out, m, l = paged_attention(

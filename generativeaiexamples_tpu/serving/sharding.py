@@ -1,0 +1,132 @@
+"""Tensor-parallel serving: shard params + KV pool over a device mesh.
+
+The reference's multi-device serving story is one env var handed to an
+external engine (INFERENCE_GPU_COUNT, deploy/compose/compose.env:17-18 —
+NCCL TP hidden inside TRT-LLM/NIM). Here TP is owned in-repo and
+TPU-native: params are placed with the Megatron-style `param_specs`
+layout (heads/mlp/vocab on the mesh "tensor" axis), the paged KV pool is
+sharded on its kv-head axis, and the engine's jitted prefill/decode
+steps run under GSPMD — XLA inserts the all-reduces over ICI.
+
+Quantized weights shard too: a `QuantizedTensor` leaf carries its int8
+payload with the full weight spec and its per-output-channel scale with
+the spec minus the contracted axis, so int8 TP serving (the 70B-on-8-
+chips deployment) needs no special casing anywhere else.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from generativeaiexamples_tpu.models.llama import LlamaConfig, param_specs
+from generativeaiexamples_tpu.ops.quant import QuantizedTensor
+
+# PagePool k/v layout is [L, P, KH, page_size, Hd]; kv-heads live on the
+# tensor axis, matching wk/wv's output-dim sharding so decode's KV
+# read/write never crosses chips.
+KV_POOL_SPEC = P(None, None, "tensor", None, None)
+
+
+def tensor_axis_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("tensor", 1))
+
+
+def is_sharded(mesh: Optional[Mesh]) -> bool:
+    return mesh is not None and mesh.devices.size > 1
+
+
+def validate_tp(cfg: LlamaConfig, mesh: Mesh) -> None:
+    """Fail fast at engine build when the geometry can't split."""
+    tp = tensor_axis_size(mesh)
+    if tp <= 1:
+        return
+    bad = {name: dim for name, dim in (
+        ("n_heads", cfg.n_heads), ("n_kv_heads", cfg.n_kv_heads),
+        ("mlp_dim", cfg.mlp_dim), ("vocab_size", cfg.vocab_size),
+    ) if dim % tp}
+    if bad:
+        raise ValueError(
+            f"tensor axis {tp} does not divide model dims {bad}; "
+            f"choose ici_tensor dividing all of heads/kv_heads/mlp/vocab")
+
+
+def _quantized_leaf_spec(spec: P) -> QuantizedTensor:
+    """Spec pair for a QuantizedTensor: q keeps the full weight spec;
+    the per-output-channel scale drops the contracted axis (-2)."""
+    if len(tuple(spec)) < 2:
+        return QuantizedTensor(spec, spec)
+    s_axes = tuple(spec)[:-2] + (tuple(spec)[-1],)
+    return QuantizedTensor(spec, P(*s_axes))
+
+
+def param_shardings(params, cfg: LlamaConfig, mesh: Mesh, rules=None):
+    """NamedSharding tree aligned with `params` (plain or int8-quantized).
+
+    Walks llama.param_specs and expands each spec to match the actual
+    leaf: QuantizedTensor leaves get a (q, s) spec pair.
+    """
+    from generativeaiexamples_tpu.parallel.mesh import LLM_RULES
+
+    specs = param_specs(cfg, rules or LLM_RULES)
+
+    def align(leaf, spec):
+        if isinstance(leaf, QuantizedTensor):
+            qs = _quantized_leaf_spec(spec)
+            return QuantizedTensor(NamedSharding(mesh, qs.q),
+                                   NamedSharding(mesh, qs.s))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(
+        align, params, specs,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor) or not isinstance(x, dict))
+
+
+def shard_llama_params(params, cfg: LlamaConfig, mesh: Mesh, rules=None):
+    """Place a (possibly quantized) llama param tree onto the mesh."""
+    validate_tp(cfg, mesh)
+    shardings = param_shardings(params, cfg, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def shard_pool(pool, mesh: Mesh):
+    """Place a PagePool's k/v on the mesh (kv-heads on tensor)."""
+    from generativeaiexamples_tpu.serving.kv_cache import PagePool
+
+    s = NamedSharding(mesh, KV_POOL_SPEC)
+    return PagePool(jax.device_put(pool.k, s), jax.device_put(pool.v, s),
+                    pool.page_size)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def compatible_mesh(lcfg: LlamaConfig, mesh: Mesh) -> Mesh:
+    """Return `mesh` if the model's dims divide its tensor axis; else
+    rebuild with the largest compatible tensor size and the remainder on
+    the data axis (dev/tiny models on big hosts should still serve, just
+    with less TP — matching the reference's 'it always boots' posture)."""
+    import math
+
+    from generativeaiexamples_tpu.config.schema import MeshConfig
+    from generativeaiexamples_tpu.parallel.mesh import build_mesh
+
+    tp = tensor_axis_size(mesh)
+    g = math.gcd(math.gcd(lcfg.n_heads, lcfg.n_kv_heads),
+                 math.gcd(lcfg.mlp_dim, lcfg.vocab_size))
+    if tp <= 1 or g % tp == 0:
+        return mesh
+    n_dev = mesh.devices.size
+    best = max(t for t in range(1, g + 1) if g % t == 0 and n_dev % t == 0)
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "mesh tensor=%d incompatible with model (gcd of shardable dims %d); "
+        "clamping to tensor=%d, data=%d", tp, g, best, n_dev // best)
+    return build_mesh(MeshConfig(ici_tensor=best, ici_data=-1),
+                      devices=mesh.devices.flatten().tolist())

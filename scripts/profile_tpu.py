@@ -165,8 +165,8 @@ def main():
             t0 = time.perf_counter()
 
             def run_decode(K=K):
-                nonlocal pool
-                out, pool = engine_model.decode_multi_step(
+                nonlocal pool, tokens
+                out, tokens, pool = engine_model.decode_multi_step(
                     params, cfg, pool, tokens, tables, lengths, active,
                     temps, top_ps, top_ks, rng, K, None,
                     sampling_flags=(True, False, False))
