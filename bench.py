@@ -83,7 +83,7 @@ def main() -> None:
     from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
 
     model = os.environ.get("BENCH_MODEL", "8b")
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     gen = int(os.environ.get("BENCH_GEN", "128"))
     page = int(os.environ.get("BENCH_PAGE", "64"))

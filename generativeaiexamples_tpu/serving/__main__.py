@@ -68,10 +68,10 @@ def build_engines(cfg, model_size: str = "tiny"):
     if cfg.engine.quantize_weights == "int8" and not cfg.engine.weights_path:
         params = quantize_llama_params(params)  # loader handles the rest
     if mesh is not None:
-        mesh = shd.compatible_mesh(lcfg, mesh)
-        logging.info("sharding llama params over mesh %s", dict(mesh.shape))
-        if not cfg.engine.weights_path:  # loader already placed real weights
+        if not cfg.engine.weights_path:  # real weights: loader already
+            mesh = shd.compatible_mesh(lcfg, mesh)  # clamped + placed above
             params = shd.shard_llama_params(params, lcfg, mesh)
+        logging.info("llama params sharded over mesh %s", dict(mesh.shape))
 
     llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh).start()
 

@@ -29,7 +29,7 @@ from generativeaiexamples_tpu.ops import attention as attn_ops
 from generativeaiexamples_tpu.ops.quant import mm
 from generativeaiexamples_tpu.serving.kv_cache import PagePool
 from generativeaiexamples_tpu.serving.paged_attention import (
-    paged_attention_dispatch, paged_attention_with_new)
+    paged_attention_dispatch)
 
 
 def _project_qkv(cfg: LlamaConfig, h, w, positions):
@@ -54,19 +54,6 @@ def _logits(cfg: LlamaConfig, params, x):
     if cfg.tie_embeddings:
         return (x @ params["tok_emb"].T.astype(x.dtype)).astype(jnp.float32)
     return mm(x, params["lm_head"]).astype(jnp.float32)
-
-
-def _write_pages_all_layers(pool: PagePool, k_stack, v_stack, page_idx, offset
-                            ) -> PagePool:
-    """One scatter per pool array writes every layer's new token k/v.
-    k_stack/v_stack: [L, B, KH, Hd]; page_idx/offset: [B]."""
-    L = pool.k.shape[0]
-    li = jnp.arange(L)[:, None]
-    k = pool.k.at[li, page_idx[None, :], :, offset[None, :], :].set(
-        k_stack.astype(pool.k.dtype))
-    v = pool.v.at[li, page_idx[None, :], :, offset[None, :], :].set(
-        v_stack.astype(pool.v.dtype))
-    return PagePool(k, v, pool.page_size)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),
@@ -103,13 +90,15 @@ def prefill_step(
         return x, (k[0].transpose(1, 0, 2), v[0].transpose(1, 0, 2))  # [S,KH,Hd]
 
     x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
-    # [L, S, KH, Hd] -> pages [L, npages, KH, ps, Hd] -> scatter once
+    # [L, S, KH, Hd] -> pages [L, npages, KH, ps, Hd]; scatter once into
+    # the [L, KH, P, ps, Hd] pool (advanced indices bracket the KH slice,
+    # so the value keeps the [L, npages, KH, ps, Hd] block layout).
     L = k_stack.shape[0]
     kw = k_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
     vw = v_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
     li = jnp.arange(L)[:, None]
-    k = pool.k.at[li, table_row[None, :]].set(kw.astype(pool.k.dtype))
-    v = pool.v.at[li, table_row[None, :]].set(vw.astype(pool.v.dtype))
+    k = pool.k.at[li, :, table_row[None, :]].set(kw.astype(pool.k.dtype))
+    v = pool.v.at[li, :, table_row[None, :]].set(vw.astype(pool.v.dtype))
     last = jnp.take_along_axis(
         x, (length - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)  # [1,1,D]
     logits = _logits(cfg, params, last)[0, 0]
@@ -166,8 +155,8 @@ def prefill_batch_step(
     kw = k_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
     vw = v_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
     li = jnp.arange(L)[:, None, None]
-    k = pool.k.at[li, table_rows[None, :, :]].set(kw.astype(pool.k.dtype))
-    v = pool.v.at[li, table_rows[None, :, :]].set(vw.astype(pool.v.dtype))
+    k = pool.k.at[li, :, table_rows[None, :, :]].set(kw.astype(pool.k.dtype))
+    v = pool.v.at[li, :, table_rows[None, :, :]].set(vw.astype(pool.v.dtype))
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
     logits = _logits(cfg, params, last)[:, 0]  # [N, V]
@@ -200,23 +189,35 @@ _UNROLL_DECODE = os.environ.get("ENGINE_UNROLL_DECODE", "1") != "0"
 
 def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
                  lengths, use_pallas, mesh=None):
-    """One decode iteration: logits + the new k/v stacks (pool untouched)."""
+    """One decode iteration, write-then-attend: each layer scatters the
+    current token's k/v into its pool slice, then paged attention runs
+    over the updated pool with `lengths` INCLUDING the current token.
+    Returns (logits [B, V], updated pool)."""
     B = tokens.shape[0]
+    ps = pool.page_size
     positions = (lengths - 1)[:, None]  # [B, 1]
+    page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]  # [B]
+    offset = (lengths - 1) % ps  # [B]
+    kh_idx = jnp.arange(cfg.n_kv_heads)[:, None]  # [KH, 1] -> bcast [KH, B]
 
     x = params["tok_emb"][tokens[:, None]].astype(cfg.dtype)  # [B, 1, D]
 
-    def body(x, layer):
-        w, kp, vp = layer  # kp/vp read-only views of the pool
+    def body(x, k_pool, v_pool, w, l):
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)  # [B, *, 1, Hd]
-        k_new, v_new = k[:, :, 0, :], v[:, :, 0, :]
-        out = paged_attention_with_new(
-            q[:, :, 0, :], kp, vp, page_tables, lengths, k_new, v_new,
+        k_new = k[:, :, 0, :].transpose(1, 0, 2)  # [KH, B, Hd]
+        v_new = v[:, :, 0, :].transpose(1, 0, 2)
+        k_pool = k_pool.at[l, kh_idx, page_idx[None, :], offset[None, :], :].set(
+            k_new.astype(k_pool.dtype))
+        v_pool = v_pool.at[l, kh_idx, page_idx[None, :], offset[None, :], :].set(
+            v_new.astype(v_pool.dtype))
+        out = paged_attention_dispatch(
+            q[:, :, 0, :], k_pool[l], v_pool[l], page_tables, lengths,
             use_pallas=use_pallas, mesh=mesh)
         x = _finish_block(cfg, x, out[:, :, None, :], w)
-        return x, (k_new, v_new)
+        return x, k_pool, v_pool
 
+    k_pool, v_pool = pool.k, pool.v
     if _UNROLL_DECODE:
         from generativeaiexamples_tpu.ops.quant import QuantizedTensor
 
@@ -225,18 +226,19 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
                 return QuantizedTensor(t.q[l], t.s[l])
             return t[l]
 
-        k_news, v_news = [], []
         for l in range(cfg.n_layers):
             w = {k2: take(v2, l) for k2, v2 in params["layers"].items()}
-            x, (k_new, v_new) = body(x, (w, pool.k[l], pool.v[l]))
-            k_news.append(k_new)
-            v_news.append(v_new)
-        k_stack = jnp.stack(k_news)
-        v_stack = jnp.stack(v_news)
+            x, k_pool, v_pool = body(x, k_pool, v_pool, w, l)
     else:
-        x, (k_stack, v_stack) = jax.lax.scan(
-            body, x, (params["layers"], pool.k, pool.v))
-    return _logits(cfg, params, x)[:, 0], k_stack, v_stack
+        def scan_body(carry, wl):
+            x, k_pool, v_pool = carry
+            w, l = wl
+            return body(x, k_pool, v_pool, w, l), None
+
+        (x, k_pool, v_pool), _ = jax.lax.scan(
+            scan_body, (x, k_pool, v_pool),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    return _logits(cfg, params, x)[:, 0], PagePool(k_pool, v_pool, ps)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),
@@ -250,14 +252,8 @@ def decode_step(
     mesh=None,
 ) -> Tuple[jax.Array, PagePool]:
     """One decode step for the whole slot batch -> (logits [B, V], pool)."""
-    B = tokens.shape[0]
-    ps = pool.page_size
-    page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]  # [B]
-    offset = (lengths - 1) % ps  # [B]
-    logits, k_stack, v_stack = _decode_once(
-        params, cfg, pool, tokens, page_tables, lengths, use_pallas, mesh)
-    pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)
-    return logits, pool
+    return _decode_once(params, cfg, pool, tokens, page_tables, lengths,
+                        use_pallas, mesh)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas",
@@ -292,18 +288,13 @@ def decode_multi_step(
     Sequences must have page capacity for n_steps more tokens."""
     from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
 
-    B = last_tokens.shape[0]
-    ps = pool.page_size
     sp = SamplingParams(temperature, top_p, top_k)
     all_greedy, any_top_k, any_top_p = sampling_flags
     tokens = last_tokens
     out_tokens = [tokens]
     for i in range(n_steps):
-        logits, k_stack, v_stack = _decode_once(
+        logits, pool = _decode_once(
             params, cfg, pool, tokens, page_tables, lengths, use_pallas, mesh)
-        page_idx = page_tables[jnp.arange(B), (lengths - 1) // ps]
-        offset = (lengths - 1) % ps
-        pool = _write_pages_all_layers(pool, k_stack, v_stack, page_idx, offset)
         rng, key = jax.random.split(rng)
         nxt = sample(logits, sp, key, all_greedy=all_greedy,
                      any_top_k=any_top_k, any_top_p=any_top_p)

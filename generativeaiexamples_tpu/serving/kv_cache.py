@@ -3,10 +3,13 @@
 The TPU-native replacement for what TRT-LLM's paged KV manager does
 inside NIM (invisible to the reference repo; SURVEY.md §2.3). Design:
 
-- Device: one page pool per model, k/v arrays [L, P, KH, page_size, Hd].
-  Page 0 is a reserved garbage sink — padding positions in bucketed
-  prefills and unused page-table slots point at it, so scatter/gather
-  never needs dynamic shapes.
+- Device: one page pool per model, k/v arrays [L, KH, P, page_size, Hd]
+  (kv-heads outermost after the layer axis: per-layer slices are the
+  [KH, P, ps, Hd] layout the JetStream-style multi-page Pallas kernel
+  wants, and the TP sharding axis is a leading dim). Page 0 is a
+  reserved garbage sink — padding positions in bucketed prefills and
+  unused page-table slots point at it, so scatter/gather never needs
+  dynamic shapes.
 - Host: PageAllocator hands out page ids (plain Python free list — the
   scheduler thread owns it; no device sync needed to allocate).
 - Page tables are [B, max_pages] int32 arrays shipped to the device each
@@ -32,19 +35,19 @@ from generativeaiexamples_tpu.models.llama import LlamaConfig
 class PagePool:
     """Device-side page pool (a pytree leaf pair) + geometry."""
 
-    k: jax.Array  # [L, P, KH, page_size, Hd]
+    k: jax.Array  # [L, KH, P, page_size, Hd]
     v: jax.Array
     page_size: int
 
     @property
     def n_pages(self) -> int:
-        return self.k.shape[1]
+        return self.k.shape[2]
 
     @staticmethod
     def zeros(cfg: LlamaConfig, n_pages: int, page_size: int = 64,
               dtype=None) -> "PagePool":
         dtype = dtype or cfg.dtype
-        shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
+        shape = (cfg.n_layers, cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
         return PagePool(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
                         page_size)
 

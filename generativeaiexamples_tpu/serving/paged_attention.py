@@ -1,21 +1,32 @@
-"""Paged decode attention: XLA gather fallback + Pallas TPU kernel.
+"""Paged decode attention: XLA gather fallback + Pallas TPU kernels.
 
 The decode hot op (SURVEY.md §7.4 hard part #1): one new query token per
-sequence attends over that sequence's KV pages. The Pallas kernel never
-materializes the gathered KV — pages stream HBM->VMEM directly via
-scalar-prefetched page-table indices in the BlockSpec index_map (the
-JetStream-style pattern), with online softmax across page steps.
+sequence attends over that sequence's KV pages. Layouts (per layer):
 
-Layouts (per layer):
   q        [B, H, Hd]           one token per sequence
-  k_pages  [P, KH, ps, Hd]      device page pool slice for this layer
+  k_pages  [KH, P, ps, Hd]      device page pool slice for this layer
   page_table [B, maxp] int32    page ids per sequence (0 = padding sink)
   lengths  [B] int32            valid tokens (incl. the new one)
+
+Kernel strategy (r2): the one-page-per-grid-step kernel paid a fixed
+per-grid-step cost x (B * maxp * L) steps, which dominated decode at
+batch >= 32 (VERDICT r1 weak #1c). Dispatch now prefers the multi-page
+JetStream-style kernel shipped with JAX
+(jax.experimental.pallas.ops.tpu.paged_attention — pages stream
+HBM->VMEM via double-buffered async copies, `pages_per_compute_block`
+pages per flash block, grid (B, KH) instead of (B, maxp)); our own
+single-page kernel remains as the in-repo fallback and the
+interpret-mode (CPU) oracle for it.
+
+Under a multi-device mesh the chosen kernel runs inside a shard_map over
+the "tensor" axis: attention is head-parallel in the Megatron layout
+(q heads and kv heads/pages both sharded on tensor), no collectives.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -27,7 +38,17 @@ try:
 except Exception:  # pragma: no cover
     pltpu = None
 
+try:
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as _stdlib_paged_attention)
+except Exception:  # pragma: no cover
+    _stdlib_paged_attention = None
+
 NEG_INF = -1e30
+
+# own | stdlib | auto (benchmark knob; auto prefers the multi-page
+# stdlib kernel on TPU when page counts allow it)
+_KERNEL_CHOICE = os.environ.get("ENGINE_PAGED_KERNEL", "auto")
 
 
 def paged_attention_reference(
@@ -36,13 +57,15 @@ def paged_attention_reference(
 ) -> jax.Array:
     """Gather-based paged attention (any backend; the numerics oracle)."""
     B, H, Hd = q.shape
-    P, KH, ps, _ = k_pages.shape
+    KH, P, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
     scale = scale if scale is not None else Hd ** -0.5
 
-    # [B, maxp, KH, ps, Hd] -> [B, KH, maxp*ps, Hd]
-    k = k_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
-    v = v_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
+    # [KH, B, maxp, ps, Hd] -> [B, KH, maxp*ps, Hd]
+    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
+        B, KH, maxp * ps, Hd)
+    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
+        B, KH, maxp * ps, Hd)
 
     from generativeaiexamples_tpu.ops.attention import mha_reference
 
@@ -52,7 +75,7 @@ def paged_attention_reference(
 
 
 # ---------------------------------------------------------------------------
-# Pallas kernel
+# In-repo Pallas kernel (single page per grid step; interpret-friendly)
 # ---------------------------------------------------------------------------
 
 
@@ -60,11 +83,9 @@ def _paged_kernel(
     lengths_ref,  # scalar prefetch [B]
     table_ref,  # scalar prefetch [B * maxp]
     q_ref,  # [1, H, Hd]
-    k_ref,  # [1, KH, ps, Hd]  (page selected by index_map)
+    k_ref,  # [KH, 1, ps, Hd]  (page selected by index_map)
     v_ref,
     o_ref,  # [1, H, Hd]
-    m_out_ref,  # [1, H, 128]  softmax running max (lane-broadcast; TPU
-    l_out_ref,  # [1, H, 128]  block shapes need (8,128)-tileable dims)
     m_ref,  # scratch [H, 128]
     l_ref,  # scratch [H, 128]
     acc_ref,  # scratch [H, Hd]
@@ -91,8 +112,8 @@ def _paged_kernel(
         KH, ps = n_kv_heads, page_size
         H = KH * group
         q = q_ref[0].astype(jnp.float32).reshape(KH, group, -1)  # [KH,g,Hd]
-        k = k_ref[0].astype(jnp.float32)  # [KH, ps, Hd]
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[:, 0].astype(jnp.float32)  # [KH, ps, Hd]
+        v = v_ref[:, 0].astype(jnp.float32)
         # Batched over kv heads: [KH, g, Hd] x [KH, ps, Hd] -> [KH, g, ps]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
@@ -123,21 +144,18 @@ def _paged_kernel(
     def _finish():
         denom = jnp.where(l_ref[:, :1] == 0.0, 1.0, l_ref[:, :1])
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
-        m_out_ref[0] = m_ref[...]
-        l_out_ref[0] = l_ref[...]
 
 
 def paged_attention(
     q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     page_table: jax.Array, lengths: jax.Array, *,
     scale: Optional[float] = None, interpret: bool = False,
-    return_softmax_state: bool = False,
 ) -> jax.Array:
-    """Pallas paged decode attention. See module docstring for layouts."""
+    """In-repo Pallas paged decode attention (see module docstring)."""
     if pltpu is None:
         raise RuntimeError("Pallas TPU unavailable; use paged_attention_reference")
     B, H, Hd = q.shape
-    P, KH, ps, _ = k_pages.shape
+    KH, P, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
     group = H // KH
     scale = scale if scale is not None else Hd ** -0.5
@@ -151,116 +169,85 @@ def paged_attention(
         grid=(B, maxp),
         in_specs=[
             pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
-            pl.BlockSpec((1, KH, ps, Hd), lambda b, p, L, T: (T[b * maxp + p], 0, 0, 0)),
-            pl.BlockSpec((1, KH, ps, Hd), lambda b, p, L, T: (T[b * maxp + p], 0, 0, 0)),
+            pl.BlockSpec((KH, 1, ps, Hd),
+                         lambda b, p, L, T: (0, T[b * maxp + p], 0, 0)),
+            pl.BlockSpec((KH, 1, ps, Hd),
+                         lambda b, p, L, T: (0, T[b * maxp + p], 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
-            pl.BlockSpec((1, H, 128), lambda b, p, L, T: (b, 0, 0)),
-            pl.BlockSpec((1, H, 128), lambda b, p, L, T: (b, 0, 0)),
-        ],
+        out_specs=pl.BlockSpec((1, H, Hd), lambda b, p, L, T: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, 128), jnp.float32),
             pltpu.VMEM((H, 128), jnp.float32),
             pltpu.VMEM((H, Hd), jnp.float32),
         ],
     )
-    out, m, l = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, 128), jnp.float32),
-        ],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
       q, k_pages, v_pages)
-    if return_softmax_state:
-        return out, m[:, :, 0], l[:, :, 0]
-    return out
 
 
-def paged_attention_dispatch(q, k_pages, v_pages, page_table, lengths, *,
-                             scale=None, use_pallas: Optional[bool] = None):
-    use_pallas = (jax.default_backend() == "tpu") if use_pallas is None else use_pallas
-    if use_pallas and pltpu is not None:
-        return paged_attention(q, k_pages, v_pages, page_table, lengths, scale=scale)
-    return paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
-                                     scale=scale)
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
 
 
-def paged_attention_with_new(
-    q: jax.Array,            # [B, H, Hd] current-token queries
-    k_pages: jax.Array,      # [P, KH, ps, Hd] pool WITHOUT the new token
-    v_pages: jax.Array,
-    page_table: jax.Array,   # [B, maxp]
-    lengths: jax.Array,      # [B] INCLUDING the new token
-    k_new: jax.Array,        # [B, KH, Hd] current-token key
-    v_new: jax.Array,
-    *, scale: Optional[float] = None, use_pallas: Optional[bool] = None,
-    interpret: bool = False, mesh=None,
-) -> jax.Array:
-    """Decode attention where the current token's k/v have NOT been
-    written to the pool yet. This keeps the page pool read-only inside
-    the per-layer scan (writes batch into one post-scan scatter — the
-    pool never round-trips through scan carries/stacked outputs, which
-    would copy the whole pool every step). The current token's
-    contribution is merged with the kernel's online-softmax state."""
-    B, H, Hd = q.shape
-    KH = k_pages.shape[1]
-    group = H // KH
-    scale = scale if scale is not None else Hd ** -0.5
-    old = lengths - 1  # tokens actually in the pool
+def _pages_per_block(maxp: int, want: Optional[int]) -> int:
+    """Largest divisor of maxp that is <= want (default 8)."""
+    want = want or 8
+    for g in range(min(want, maxp), 0, -1):
+        if maxp % g == 0:
+            return g
+    return 1
+
+
+def _paged_tpu(q, k_pages, v_pages, page_table, lengths, *, scale,
+               interpret, pages_per_compute_block):
+    maxp = page_table.shape[1]
+    use_stdlib = (_stdlib_paged_attention is not None and not interpret
+                  and _KERNEL_CHOICE in ("auto", "stdlib"))
+    if use_stdlib:
+        ppcb = _pages_per_block(maxp, pages_per_compute_block)
+        # The stdlib kernel applies no softmax scale — fold it into q.
+        Hd = q.shape[-1]
+        s = scale if scale is not None else Hd ** -0.5
+        return _stdlib_paged_attention(
+            (q.astype(jnp.float32) * s).astype(q.dtype),
+            k_pages, v_pages, lengths.astype(jnp.int32),
+            page_table.astype(jnp.int32), pages_per_compute_block=ppcb)
+    return paged_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=scale, interpret=interpret)
+
+
+def paged_attention_dispatch(
+    q, k_pages, v_pages, page_table, lengths, *, scale=None,
+    use_pallas: Optional[bool] = None, mesh=None, interpret: bool = False,
+    pages_per_compute_block: Optional[int] = None,
+):
+    """Pick the fastest available implementation for the current
+    backend/mesh. `lengths` INCLUDES the current token, whose k/v must
+    already be written to the pool (write-then-attend decode)."""
     use_pallas = (jax.default_backend() == "tpu") if use_pallas is None \
         else use_pallas
-
-    if use_pallas and pltpu is not None and mesh is not None \
-            and mesh.shape.get("tensor", 1) > 1:
-        # TP: heads and kv-pages are both sharded on the tensor axis
-        # (Megatron layout), so paged decode attention is embarrassingly
-        # head-parallel — shard_map runs the kernel per chip on its local
-        # heads/pages slice; page tables and lengths are replicated.
+    if not use_pallas or pltpu is None:
+        return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                         lengths, scale=scale)
+    if mesh is not None and mesh.shape.get("tensor", 1) > 1:
         from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
         hs = P(None, "tensor", None)
-        pool_s = P(None, "tensor", None, None)
+        pool_s = P("tensor", None, None, None)
         fn = shard_map(
-            lambda q_, kp_, vp_, t_, ln_, kn_, vn_: paged_attention_with_new(
-                q_, kp_, vp_, t_, ln_, kn_, vn_, scale=scale,
-                use_pallas=True, interpret=interpret),
-            mesh=mesh,
-            in_specs=(hs, pool_s, pool_s, P(), P(), hs, hs),
+            lambda q_, kp_, vp_, t_, ln_: _paged_tpu(
+                q_, kp_, vp_, t_, ln_, scale=scale, interpret=interpret,
+                pages_per_compute_block=pages_per_compute_block),
+            mesh=mesh, in_specs=(hs, pool_s, pool_s, P(), P()),
             out_specs=hs, check_rep=False)
-        return fn(q, k_pages, v_pages, page_table, lengths, k_new, v_new)
-
-    if use_pallas and pltpu is not None:
-        out, m, l = paged_attention(
-            q, k_pages, v_pages, page_table, old, scale=scale,
-            interpret=interpret, return_softmax_state=True)
-        s = (q.reshape(B, KH, group, Hd).astype(jnp.float32)
-             * k_new[:, :, None, :].astype(jnp.float32)).sum(-1) * scale
-        s = s.reshape(B, H)  # [B, H] self-attention logit
-        m2 = jnp.maximum(m, s)
-        alpha = jnp.exp(m - m2)
-        beta = jnp.exp(s - m2)
-        v_exp = jnp.repeat(v_new, group, axis=1).astype(jnp.float32)  # [B,H,Hd]
-        num = (out.astype(jnp.float32) * (l * alpha)[..., None]
-               + beta[..., None] * v_exp)
-        den = (l * alpha + beta)[..., None]
-        return (num / den).astype(q.dtype)
-
-    # XLA path: gather pages, place the new token at its position, mask.
-    P, _, ps, _ = k_pages.shape
-    maxp = page_table.shape[1]
-    k = k_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
-    v = v_pages[page_table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
-    bidx = jnp.arange(B)
-    k = k.at[bidx, :, old, :].set(k_new.astype(k.dtype))
-    v = v.at[bidx, :, old, :].set(v_new.astype(v.dtype))
-    from generativeaiexamples_tpu.ops.attention import mha_reference
-
-    out = mha_reference(q[:, :, None, :], k, v, causal=False, lengths=lengths,
-                        scale=scale)
-    return out[:, :, 0, :]
+        return fn(q, k_pages, v_pages, page_table, lengths)
+    return _paged_tpu(q, k_pages, v_pages, page_table, lengths, scale=scale,
+                      interpret=interpret,
+                      pages_per_compute_block=pages_per_compute_block)

@@ -24,10 +24,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from generativeaiexamples_tpu.models.llama import LlamaConfig, param_specs
 from generativeaiexamples_tpu.ops.quant import QuantizedTensor
 
-# PagePool k/v layout is [L, P, KH, page_size, Hd]; kv-heads live on the
+# PagePool k/v layout is [L, KH, P, page_size, Hd]; kv-heads live on the
 # tensor axis, matching wk/wv's output-dim sharding so decode's KV
 # read/write never crosses chips.
-KV_POOL_SPEC = P(None, None, "tensor", None, None)
+KV_POOL_SPEC = P(None, "tensor", None, None, None)
 
 
 def tensor_axis_size(mesh: Optional[Mesh]) -> int:

@@ -30,8 +30,8 @@ def _rand(shape, seed=0):
 class TestPagedAttention:
     def _setup(self, B=2, H=4, KH=2, Hd=16, ps=8, maxp=4, P=16):
         q = _rand((B, H, Hd), 1)
-        k_pages = _rand((P, KH, ps, Hd), 2)
-        v_pages = _rand((P, KH, ps, Hd), 3)
+        k_pages = _rand((KH, P, ps, Hd), 2)
+        v_pages = _rand((KH, P, ps, Hd), 3)
         table = jnp.asarray(
             np.random.default_rng(0).choice(np.arange(1, P), (B, maxp),
                                             replace=False).astype(np.int32))
@@ -45,10 +45,10 @@ class TestPagedAttention:
         q, kp, vp, table, lengths = self._setup()
         got = paged_attention_reference(q, kp, vp, table, lengths)
         B, H, Hd = q.shape
-        _, KH, ps, _ = kp.shape
+        KH, _, ps, _ = kp.shape
         maxp = table.shape[1]
-        k = kp[table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
-        v = vp[table].transpose(0, 2, 1, 3, 4).reshape(B, KH, maxp * ps, Hd)
+        k = kp[:, table].transpose(1, 0, 2, 3, 4).reshape(B, KH, maxp * ps, Hd)
+        v = vp[:, table].transpose(1, 0, 2, 3, 4).reshape(B, KH, maxp * ps, Hd)
         want = mha_reference(q[:, :, None], k, v, causal=False,
                              lengths=lengths)[:, :, 0]
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
@@ -202,41 +202,29 @@ class TestSampling:
         assert jnp.mean(jnp.abs(full - quant)) < 0.15
 
 
-class TestPagedAttentionWithNew:
-    def test_matches_write_then_attend(self):
-        """Merged-softmax decode (pool untouched) must equal writing the
-        token first and attending over the updated pool."""
+class TestPagedDispatch:
+    def test_dispatch_paths_agree(self):
+        """Write-then-attend contract: the dispatcher's kernel paths and
+        the gather reference agree on a pool that already contains the
+        current token at lengths-1."""
         from generativeaiexamples_tpu.serving.paged_attention import (
-            paged_attention_reference, paged_attention_with_new)
+            paged_attention_dispatch, paged_attention_reference)
 
         B, H, KH, Hd, ps, maxp, P = 2, 4, 2, 16, 8, 4, 16
         q = _rand((B, H, Hd), 10)
-        kp = _rand((P, KH, ps, Hd), 11)
-        vp = _rand((P, KH, ps, Hd), 12)
-        k_new = _rand((B, KH, Hd), 13)
-        v_new = _rand((B, KH, Hd), 14)
+        kp = _rand((KH, P, ps, Hd), 11)
+        vp = _rand((KH, P, ps, Hd), 12)
         table = jnp.asarray(
             np.arange(1, 1 + B * maxp).reshape(B, maxp).astype(np.int32))
-        lengths = jnp.array([ps * 2 + 4, 7], jnp.int32)  # incl. new token
+        lengths = jnp.array([ps * 2 + 4, 7], jnp.int32)  # incl. current token
 
-        # ground truth: write new kv into the pool, then attend
-        bidx = np.arange(B)
-        page_idx = np.asarray(table)[bidx, (np.asarray(lengths) - 1) // ps]
-        off = (np.asarray(lengths) - 1) % ps
-        kp2 = np.asarray(kp).copy()
-        vp2 = np.asarray(vp).copy()
-        kp2[page_idx, :, off, :] = np.asarray(k_new)
-        vp2[page_idx, :, off, :] = np.asarray(v_new)
-        want = paged_attention_reference(
-            q, jnp.asarray(kp2), jnp.asarray(vp2), table, lengths)
-
-        got_ref = paged_attention_with_new(
-            q, kp, vp, table, lengths, k_new, v_new, use_pallas=False)
+        want = paged_attention_reference(q, kp, vp, table, lengths)
+        got_ref = paged_attention_dispatch(q, kp, vp, table, lengths,
+                                           use_pallas=False)
         np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want),
                                    atol=2e-5)
-        got_pl = paged_attention_with_new(
-            q, kp, vp, table, lengths, k_new, v_new, use_pallas=True,
-            interpret=True)
+        got_pl = paged_attention_dispatch(q, kp, vp, table, lengths,
+                                          use_pallas=True, interpret=True)
         np.testing.assert_allclose(np.asarray(got_pl), np.asarray(want),
                                    atol=2e-5)
 

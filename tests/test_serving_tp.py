@@ -129,7 +129,7 @@ def test_llama3_70b_int8_tp8_decode_compiles(eight_dev_mesh):
 
     B, ps, maxp = 8, 64, 4
     kv_sh = jax.sharding.NamedSharding(mesh, shd.KV_POOL_SPEC)
-    kv_shape = (cfg.n_layers, 32, cfg.n_kv_heads, ps, cfg.head_dim)
+    kv_shape = (cfg.n_layers, cfg.n_kv_heads, 32, ps, cfg.head_dim)
     pool = PagePool(jax.ShapeDtypeStruct(kv_shape, jnp.bfloat16, sharding=kv_sh),
                     jax.ShapeDtypeStruct(kv_shape, jnp.bfloat16, sharding=kv_sh),
                     ps)
