@@ -1,0 +1,15 @@
+"""rag-playground UI: chat client + web server.
+
+TPU-native port of the reference frontend
+(RetrievalAugmentedGeneration/frontend/): same capability surface —
+SSE-consuming chat with optional knowledge base, KB upload/list/delete,
+document search side panel, W3C trace propagation — rebuilt as a
+dependency-light aiohttp app with vanilla-JS pages instead of
+FastAPI+Gradio (neither is in the TPU image, and three serialization
+hops per token was the reference's own hot-loop complaint, SURVEY.md
+§3.2).
+"""
+
+from generativeaiexamples_tpu.ui.chat_client import ChatClient
+
+__all__ = ["ChatClient"]

@@ -1,0 +1,47 @@
+"""Playground launcher: `python -m generativeaiexamples_tpu.ui`.
+
+CLI parity with the reference frontend entrypoint
+(frontend/__main__.py:29-100): --config / --host / --port / -v, plus the
+chain-server URL (APP_SERVERURL/APP_SERVERPORT env in the reference
+compose files, rag-app-text-chatbot.yaml:70-72).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8090)
+    ap.add_argument("--chain-server",
+                    default=os.environ.get("APP_SERVERURL",
+                                           "http://localhost:8081"),
+                    help="chain server base URL")
+    ap.add_argument("--model-name",
+                    default=os.environ.get("APP_MODELNAME", "local"))
+    ap.add_argument("--config", default=None, help="YAML/JSON config file")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from generativeaiexamples_tpu.config.wizard import load_config
+    from generativeaiexamples_tpu.obs import tracing
+    from generativeaiexamples_tpu.ui.chat_client import ChatClient
+    from generativeaiexamples_tpu.ui.server import (
+        PlaygroundServer, run_server)
+
+    cfg = load_config(args.config)
+    tracing.setup(cfg)
+    client = ChatClient(args.chain_server, args.model_name)
+    server = PlaygroundServer(client)
+    logging.info("playground on %s:%d -> chain server %s",
+                 args.host, args.port, args.chain_server)
+    run_server(server, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""Chat client for the chain server REST API.
+
+Python twin of the reference's frontend client
+(frontend/frontend/chat_client.py:30-205): SSE `data: ` + JSON parse per
+line for /generate, multipart /documents upload, /search, list/delete —
+with W3C trace-context injection on every call
+(frontend/tracing.py:46-79). Used by the playground web server and as
+the programmatic client in tests/eval harnesses.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mimetypes
+import os
+from typing import Dict, Generator, List, Optional, Union
+
+import requests
+
+from generativeaiexamples_tpu.obs import tracing
+
+_LOG = logging.getLogger(__name__)
+
+
+class ChatClient:
+    """A client for the chain server (reference chat_client.py:30)."""
+
+    def __init__(self, server_url: str, model_name: str = "local") -> None:
+        self.server_url = server_url.rstrip("/")
+        self._model_name = model_name
+
+    @property
+    def model_name(self) -> str:
+        return self._model_name
+
+    # -- internals ---------------------------------------------------------
+
+    def _headers(self, span) -> Dict[str, str]:
+        """Inject the active span's context as W3C headers (the carrier
+        pattern, reference frontend/tracing.py:46-50)."""
+        headers = {"accept": "application/json"}
+        try:
+            tracing.inject_context(headers)
+        except Exception:  # tracing must never break the request path
+            pass
+        return headers
+
+    # -- API surface (parity: chat_client.py) ------------------------------
+
+    def health(self) -> bool:
+        try:
+            r = requests.get(f"{self.server_url}/health", timeout=5)
+            return r.status_code == 200
+        except requests.RequestException:
+            return False
+
+    def search(self, prompt: str, top_k: int = 4
+               ) -> List[Dict[str, Union[str, float]]]:
+        """Search for relevant documents (chat_client.py:44-71)."""
+        with tracing.span("search", {"prompt": prompt[:256]}):
+            try:
+                r = requests.post(
+                    f"{self.server_url}/search",
+                    headers=self._headers(None),
+                    json={"query": prompt, "top_k": top_k}, timeout=30)
+                r.raise_for_status()
+                body = r.json()
+                # chain server returns {"chunks": [...]}
+                return body.get("chunks", body) if isinstance(body, dict) else body
+            except requests.RequestException as e:
+                _LOG.error("search failed against %s: %s", self.server_url, e)
+                return []
+
+    def predict(self, query: str, use_knowledge_base: bool,
+                num_tokens: int = 1024,
+                stop: Optional[List[str]] = None,
+                ) -> Generator[Optional[str], None, None]:
+        """Stream a response; yields text chunks then None at the end
+        (chat_client.py:73-115 contract, including the error-string
+        fallback instead of raising)."""
+        data = {
+            "messages": [{"role": "user", "content": query}],
+            "use_knowledge_base": use_knowledge_base,
+            "max_tokens": num_tokens,
+        }
+        if stop:
+            data["stop"] = stop
+        with tracing.span("predict",
+                          {"use_knowledge_base": use_knowledge_base}) as sp:
+            built = ""
+            try:
+                with requests.post(f"{self.server_url}/generate", stream=True,
+                                   json=data, timeout=300,
+                                   headers=self._headers(sp)) as req:
+                    req.raise_for_status()
+                    for chunk in req.iter_lines():
+                        raw = chunk.decode("utf-8")
+                        if not raw.startswith("data: "):
+                            continue
+                        payload = raw[6:]
+                        try:
+                            resp = json.loads(payload)
+                        except json.JSONDecodeError as e:
+                            raise ValueError(
+                                f"Invalid response json: {raw}") from e
+                        choices = resp.get("choices", [])
+                        if choices:
+                            finish = choices[0].get("finish_reason")
+                            if finish == "[DONE]":
+                                break
+                            text = choices[0].get("message", {}).get(
+                                "content", "")
+                            built += text
+                            yield text
+            except (requests.RequestException, ValueError) as e:
+                _LOG.error("predict failed against %s: %s",
+                           self.server_url, e)
+                yield ("Failed to get response from /generate endpoint of "
+                       "chain-server. Check if the server is up. Refer to "
+                       "chain-server logs for details.")
+            if sp is not None:
+                try:
+                    sp.set_attribute("response", built[:2048])
+                except Exception:
+                    pass
+            yield None  # end-of-response sentinel (reference parity)
+
+    def upload_documents(self, file_paths: List[str]) -> None:
+        """Upload documents to the KB (chat_client.py:118-147). Raises
+        ValueError with the server's message on failure."""
+        with tracing.span("upload_documents", {"n": len(file_paths)}):
+            for fpath in file_paths:
+                mime, _ = mimetypes.guess_type(fpath)
+                with open(fpath, "rb") as fh:
+                    files = {"file": (os.path.basename(fpath), fh, mime)}
+                    resp = requests.post(f"{self.server_url}/documents",
+                                         headers=self._headers(None),
+                                         files=files, timeout=600)
+                if resp.status_code >= 400:
+                    try:
+                        msg = resp.json().get("message",
+                                              resp.json().get("detail"))
+                    except Exception:
+                        msg = resp.text[:200]
+                    raise ValueError(str(msg or "Failed to upload document"))
+
+    def delete_documents(self, file_name: str) -> Union[str, dict]:
+        """Delete a document by filename (chat_client.py:148-173)."""
+        with tracing.span("delete_documents", {"filename": file_name}):
+            try:
+                r = requests.delete(f"{self.server_url}/documents",
+                                    headers=self._headers(None),
+                                    params={"filename": file_name}, timeout=30)
+                r.raise_for_status()
+                return r.json()
+            except requests.RequestException as e:
+                _LOG.error("delete failed for %s: %s", file_name, e)
+                return ""
+
+    def get_uploaded_documents(self) -> List[str]:
+        """List KB documents (chat_client.py:174-205)."""
+        with tracing.span("get_uploaded_documents"):
+            try:
+                r = requests.get(f"{self.server_url}/documents",
+                                 headers=self._headers(None), timeout=600)
+                if r.status_code >= 500:
+                    raise ValueError(r.json().get(
+                        "message", "Failed to get uploaded documents"))
+                return r.json().get("documents", [])
+            except requests.ConnectionError as e:
+                # Chain server may start after the playground; don't crash.
+                _LOG.error("documents endpoint unreachable: %s", e)
+                return []

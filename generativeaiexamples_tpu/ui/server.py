@@ -1,0 +1,162 @@
+"""Playground web server: static pages + thin JSON/SSE proxy.
+
+Route parity with the reference APIServer
+(frontend/frontend/api.py:48-71): `/` and `/converse` serve the chat
+page, `/kb` the knowledge-base page; the page scripts call the `/api/*`
+endpoints below, which proxy to the chain server through ChatClient so
+every hop carries W3C trace context. The reference pushed tokens
+through Gradio's queue — three serialization hops per token
+(SURVEY.md §3.2); here the SSE stream is re-emitted directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import tempfile
+from typing import Optional
+
+from aiohttp import web
+
+from generativeaiexamples_tpu.ui.chat_client import ChatClient
+
+_LOG = logging.getLogger(__name__)
+STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+
+class PlaygroundServer:
+    """aiohttp app wrapping a ChatClient (reference APIServer)."""
+
+    def __init__(self, client: ChatClient) -> None:
+        self.client = client
+        self.app = web.Application(client_max_size=100 * 1024 * 1024)
+        self.app.add_routes([
+            web.get("/", self.page_converse),
+            web.get("/converse", self.page_converse),
+            web.get("/kb", self.page_kb),
+            web.get("/health", self.handle_health),
+            web.post("/api/chat", self.handle_chat),
+            web.post("/api/search", self.handle_search),
+            web.get("/api/documents", self.handle_list),
+            web.post("/api/documents", self.handle_upload),
+            web.delete("/api/documents", self.handle_delete),
+        ])
+        self.app.router.add_static("/static", STATIC_DIR)
+
+    # -- pages -------------------------------------------------------------
+
+    async def page_converse(self, request: web.Request) -> web.FileResponse:
+        return web.FileResponse(os.path.join(STATIC_DIR, "converse.html"))
+
+    async def page_kb(self, request: web.Request) -> web.FileResponse:
+        return web.FileResponse(os.path.join(STATIC_DIR, "kb.html"))
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        up = await asyncio.to_thread(self.client.health)
+        return web.json_response(
+            {"message": "Service is up." if up else "chain server unreachable",
+             "chain_server": up}, status=200 if up else 503)
+
+    # -- API proxies -------------------------------------------------------
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        """Browser -> SSE -> ChatClient.predict -> chain server. Emits
+        {"content": ...} data lines and a final {"done": true} with the
+        search context when use_knowledge_base is on."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"detail": "invalid JSON"}, status=422)
+        query = (body.get("query") or "").strip()
+        if not query:
+            return web.json_response({"detail": "query required"}, status=422)
+        use_kb = bool(body.get("use_knowledge_base", False))
+        num_tokens = int(body.get("max_tokens", 1024))
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        docs = []
+        if use_kb:
+            docs = await asyncio.to_thread(self.client.search, query)
+
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def pump():
+            try:
+                for chunk in self.client.predict(query, use_kb,
+                                                 num_tokens=num_tokens):
+                    loop.call_soon_threadsafe(queue.put_nowait, chunk)
+            except Exception as e:  # surface, don't hang the stream
+                _LOG.exception("predict pump failed")
+                loop.call_soon_threadsafe(queue.put_nowait, f"[error] {e}")
+                loop.call_soon_threadsafe(queue.put_nowait, None)
+
+        task = asyncio.get_running_loop().run_in_executor(None, pump)
+        try:
+            while True:
+                chunk = await queue.get()
+                if chunk is None:
+                    break
+                await resp.write(
+                    b"data: " + json.dumps({"content": chunk}).encode()
+                    + b"\n\n")
+            await resp.write(
+                b"data: " + json.dumps({"done": True, "context": docs}).encode()
+                + b"\n\n")
+        finally:
+            await task
+        return resp
+
+    async def handle_search(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        chunks = await asyncio.to_thread(
+            self.client.search, body.get("query", ""),
+            int(body.get("top_k", 4)))
+        return web.json_response({"chunks": chunks})
+
+    async def handle_list(self, request: web.Request) -> web.Response:
+        docs = await asyncio.to_thread(self.client.get_uploaded_documents)
+        return web.json_response({"documents": docs})
+
+    async def handle_upload(self, request: web.Request) -> web.Response:
+        reader = await request.multipart()
+        field = await reader.next()
+        while field is not None and field.name != "file":
+            field = await reader.next()
+        if field is None:
+            return web.json_response({"detail": "file field required"},
+                                     status=422)
+        fname = os.path.basename(field.filename or "upload.txt")
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, fname)
+            with open(path, "wb") as fh:
+                while True:
+                    chunk = await field.read_chunk()
+                    if not chunk:
+                        break
+                    fh.write(chunk)
+            try:
+                await asyncio.to_thread(self.client.upload_documents, [path])
+            except ValueError as e:
+                return web.json_response({"message": str(e)}, status=500)
+        return web.json_response({"message": f"File {fname} uploaded"})
+
+    async def handle_delete(self, request: web.Request) -> web.Response:
+        fname = request.query.get("filename", "")
+        if not fname:
+            return web.json_response({"detail": "filename required"},
+                                     status=422)
+        out = await asyncio.to_thread(self.client.delete_documents, fname)
+        return web.json_response(out if isinstance(out, dict)
+                                 else {"message": str(out)})
+
+
+def run_server(server: PlaygroundServer, host: str, port: int) -> None:
+    web.run_app(server.app, host=host, port=port, print=None)

@@ -1,0 +1,80 @@
+// Chat page: POST /api/chat, consume the SSE stream token by token
+// (the reference's _stream_predict loop, pages/converse.py:246-269).
+const log = document.getElementById("chat-log");
+const ctxPanel = document.getElementById("context");
+const form = document.getElementById("compose");
+const input = document.getElementById("query");
+const useKb = document.getElementById("use-kb");
+const sendBtn = document.getElementById("send");
+
+function addMsg(cls, text) {
+  const div = document.createElement("div");
+  div.className = "msg " + cls;
+  div.textContent = text;
+  log.appendChild(div);
+  log.scrollTop = log.scrollHeight;
+  return div;
+}
+
+function renderContext(chunks) {
+  ctxPanel.innerHTML = "";
+  (chunks || []).forEach((c) => {
+    const d = document.createElement("div");
+    d.className = "doc-chunk";
+    const score = typeof c.score === "number" ? c.score.toFixed(3) : "";
+    d.innerHTML = '<span class="score">' + score + '</span>' +
+      '<div class="src"></div><div class="txt"></div>';
+    d.querySelector(".src").textContent = c.filename || c.source || "";
+    d.querySelector(".txt").textContent = (c.content || "").slice(0, 400);
+    ctxPanel.appendChild(d);
+  });
+}
+
+form.addEventListener("submit", async (ev) => {
+  ev.preventDefault();
+  const query = input.value.trim();
+  if (!query) return;
+  input.value = "";
+  sendBtn.disabled = true;
+  addMsg("user", query);
+  const bot = addMsg("bot", "");
+  try {
+    const resp = await fetch("/api/chat", {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({
+        query: query,
+        use_knowledge_base: useKb.checked,
+      }),
+    });
+    if (!resp.ok) {
+      bot.textContent = "[error] " + (await resp.text());
+      return;
+    }
+    const reader = resp.body.getReader();
+    const decoder = new TextDecoder();
+    let buf = "";
+    for (;;) {
+      const { done, value } = await reader.read();
+      if (done) break;
+      buf += decoder.decode(value, { stream: true });
+      const lines = buf.split("\n\n");
+      buf = lines.pop();
+      for (const line of lines) {
+        if (!line.startsWith("data: ")) continue;
+        const msg = JSON.parse(line.slice(6));
+        if (msg.done) {
+          renderContext(msg.context);
+        } else if (msg.content) {
+          bot.textContent += msg.content;
+          log.scrollTop = log.scrollHeight;
+        }
+      }
+    }
+  } catch (e) {
+    bot.textContent += "\n[stream error] " + e;
+  } finally {
+    sendBtn.disabled = false;
+    input.focus();
+  }
+});

@@ -1,0 +1,143 @@
+"""Playground UI: ChatClient streaming against a live chain server, and
+the web server's page + API proxy surface (reference parity:
+frontend/frontend/chat_client.py, api.py, pages/converse.py)."""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from generativeaiexamples_tpu.api.server import ChainServer
+from generativeaiexamples_tpu.config.wizard import load_config
+from generativeaiexamples_tpu.connectors.fakes import EchoLLM, HashEmbedder
+from generativeaiexamples_tpu.pipelines.base import get_example_class
+from generativeaiexamples_tpu.pipelines.resources import Resources
+from generativeaiexamples_tpu.ui.chat_client import ChatClient
+from generativeaiexamples_tpu.ui.server import PlaygroundServer
+
+
+def _make_chain(tmp_path, script=None):
+    cfg = load_config(path="", env={})
+    res = Resources(cfg, llm=EchoLLM(script=script),
+                    embedder=HashEmbedder(64), reranker=None)
+    ex = get_example_class("developer_rag")(res)
+    return ChainServer(cfg, example=ex, upload_dir=str(tmp_path / "up"))
+
+
+def _with_stack(tmp_path, fn, script=None):
+    """Run `fn(ui_client, chat_client)` against a real localhost chain
+    server + playground server pair."""
+
+    async def runner():
+        chain = _make_chain(tmp_path, script)
+        chain_srv = TestServer(chain.app)
+        await chain_srv.start_server()
+        url = f"http://{chain_srv.host}:{chain_srv.port}"
+        client = ChatClient(url, "test-model")
+        ui_client = TestClient(TestServer(PlaygroundServer(client).app))
+        await ui_client.start_server()
+        try:
+            return await fn(ui_client, client)
+        finally:
+            await ui_client.close()
+            await chain_srv.close()
+
+    return asyncio.run(runner())
+
+
+def test_chat_client_streams_full_conversation(tmp_path):
+    """The programmatic client streams chunk-by-chunk and terminates with
+    the None sentinel (reference chat_client.py:73-115 contract)."""
+
+    async def body(ui_client, client):
+        chunks = await asyncio.to_thread(
+            lambda: list(client.predict("stream me a story",
+                                        use_knowledge_base=False)))
+        assert chunks[-1] is None
+        text = "".join(c for c in chunks if c)
+        assert "stream me a story" in text  # EchoLLM echoes
+        assert len([c for c in chunks if c]) > 1  # actually streamed
+        assert await asyncio.to_thread(client.health)
+
+    _with_stack(tmp_path, body)
+
+
+def test_chat_client_kb_roundtrip(tmp_path):
+    """upload -> list -> search -> rag answer -> delete, all through the
+    client (reference kb page flow)."""
+
+    async def body(ui_client, client):
+        doc = tmp_path / "facts.txt"
+        doc.write_text("The TPU v5e has 16 GB of HBM per chip.")
+        await asyncio.to_thread(client.upload_documents, [str(doc)])
+        docs = await asyncio.to_thread(client.get_uploaded_documents)
+        assert "facts.txt" in docs
+        hits = await asyncio.to_thread(client.search, "TPU HBM")
+        assert hits and "16 GB" in hits[0]["content"]
+        out = await asyncio.to_thread(
+            lambda: list(client.predict("how much HBM?",
+                                        use_knowledge_base=True)))
+        assert out[-1] is None and any(out[:-1])
+        await asyncio.to_thread(client.delete_documents, "facts.txt")
+        docs = await asyncio.to_thread(client.get_uploaded_documents)
+        assert "facts.txt" not in docs
+
+    _with_stack(tmp_path, body)
+
+
+def test_playground_pages_and_chat_proxy(tmp_path):
+    async def body(ui_client, client):
+        for path in ("/", "/converse", "/kb"):
+            r = await ui_client.get(path)
+            assert r.status == 200
+            assert "RAG Playground" in await r.text()
+        r = await ui_client.get("/static/converse.js")
+        assert r.status == 200
+
+        r = await ui_client.post("/api/chat", json={
+            "query": "hello proxy", "use_knowledge_base": False})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = (await r.read()).decode()
+        frames = [json.loads(ln[6:]) for ln in raw.split("\n\n")
+                  if ln.startswith("data: ")]
+        assert frames[-1].get("done") is True
+        text = "".join(f.get("content", "") for f in frames)
+        assert "hello proxy" in text
+
+    _with_stack(tmp_path, body)
+
+
+def test_playground_kb_proxy(tmp_path):
+    async def body(ui_client, client):
+        import aiohttp
+
+        form = aiohttp.FormData()
+        form.add_field("file", b"Pallas kernels stream pages into VMEM.",
+                       filename="kernels.txt", content_type="text/plain")
+        r = await ui_client.post("/api/documents", data=form)
+        assert r.status == 200, await r.text()
+
+        r = await ui_client.get("/api/documents")
+        assert (await r.json())["documents"] == ["kernels.txt"]
+
+        r = await ui_client.post("/api/search",
+                                 json={"query": "VMEM pages"})
+        chunks = (await r.json())["chunks"]
+        assert chunks and "VMEM" in chunks[0]["content"]
+
+        # chat with KB on returns retrieved context in the final frame
+        r = await ui_client.post("/api/chat", json={
+            "query": "what streams into VMEM?", "use_knowledge_base": True})
+        raw = (await r.read()).decode()
+        frames = [json.loads(ln[6:]) for ln in raw.split("\n\n")
+                  if ln.startswith("data: ")]
+        assert frames[-1]["done"] is True
+        assert frames[-1]["context"], "expected retrieved context"
+
+        r = await ui_client.delete("/api/documents?filename=kernels.txt")
+        assert r.status == 200
+        r = await ui_client.get("/api/documents")
+        assert (await r.json())["documents"] == []
+
+    _with_stack(tmp_path, body)
