@@ -199,12 +199,16 @@ class LLMEngine:
         self.max_pages = self.ecfg.max_seq_len // ps
         if n_pages is None:
             n_pages = self.ecfg.max_batch_size * self.max_pages + 1
-        self.pool = PagePool.zeros(cfg, n_pages, ps,
-                                   dtype=jnp.dtype(self.ecfg.kv_dtype))
+        kv_sharding = None
         if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
             from generativeaiexamples_tpu.serving import sharding as shd
 
-            self.pool = shd.shard_pool(self.pool, self.mesh)
+            kv_sharding = NamedSharding(self.mesh, shd.KV_POOL_SPEC)
+        self.pool = PagePool.zeros(cfg, n_pages, ps,
+                                   dtype=jnp.dtype(self.ecfg.kv_dtype),
+                                   sharding=kv_sharding)
         self.allocator = PageAllocator(n_pages)
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()

@@ -45,9 +45,16 @@ class PagePool:
 
     @staticmethod
     def zeros(cfg: LlamaConfig, n_pages: int, page_size: int = 64,
-              dtype=None) -> "PagePool":
+              dtype=None, sharding=None) -> "PagePool":
+        """With `sharding`, each buffer is allocated ALREADY sharded
+        (jit with out_shardings) — a TP-serving pool sized to fill the
+        whole mesh must never materialize on one device first."""
         dtype = dtype or cfg.dtype
         shape = (cfg.n_layers, cfg.n_kv_heads, n_pages, page_size, cfg.head_dim)
+        if sharding is not None:
+            alloc = jax.jit(lambda: jnp.zeros(shape, dtype),
+                            out_shardings=sharding)
+            return PagePool(alloc(), alloc(), page_size)
         return PagePool(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
                         page_size)
 

@@ -1,0 +1,19 @@
+"""Streaming RAG: the continuous-ingest capability surface.
+
+TPU-native port of the reference's fm-asr-streaming-rag experimental
+app (experimental/fm-asr-streaming-rag/): live signal -> FM demod ->
+ASR -> incremental text accumulation -> time-indexed retrieval with
+intent-routed answering and recursive summarization. The CuPy/Holoscan
+GPU DSP kernels become jittable JAX signal ops (dsp.py), the Riva gRPC
+ASR becomes a pluggable client seam (asr.py), and the file-replay fake
+source (wav_replay.py) becomes replay.py so the whole pipeline runs
+hermetically without radio hardware or an ASR service.
+"""
+
+from generativeaiexamples_tpu.streaming.accumulator import TextAccumulator
+from generativeaiexamples_tpu.streaming.chains import (
+    StreamingRagChain, TimeResponse, UserIntent)
+from generativeaiexamples_tpu.streaming.timestamps import TimestampDatabase
+
+__all__ = ["TextAccumulator", "TimestampDatabase", "StreamingRagChain",
+           "TimeResponse", "UserIntent"]

@@ -1,0 +1,3 @@
+from generativeaiexamples_tpu.streaming.server import main
+
+main()

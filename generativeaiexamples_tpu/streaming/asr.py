@@ -1,0 +1,130 @@
+"""Pluggable ASR/TTS client seam.
+
+The reference talks to Riva over streaming gRPC
+(frontend/frontend/asr_utils.py:42-152, tts_utils.py:37-127). ASR/TTS
+models are out of scope for the TPU serving stack (SURVEY.md §2.3 calls
+this a keep-pluggable seam), so this module defines the protocol, an
+HTTP client for any OpenAI-audio-compatible endpoint, and a scripted
+fake that makes the whole SDR -> ASR -> RAG pipeline hermetically
+testable (the reference's file-replay trick, extended to transcription).
+"""
+
+from __future__ import annotations
+
+import io
+import wave
+from typing import Iterator, List, Optional, Protocol
+
+import numpy as np
+
+
+class ASRClient(Protocol):
+    def transcribe(self, pcm: np.ndarray, sample_rate: int) -> str:
+        """int16 PCM chunk -> transcript text ('' when silence)."""
+        ...
+
+
+class TTSClient(Protocol):
+    def synthesize(self, text: str, sample_rate: int = 22050) -> np.ndarray:
+        """Text -> int16 PCM audio."""
+        ...
+
+
+def pcm_to_wav_bytes(pcm: np.ndarray, sample_rate: int) -> bytes:
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(np.asarray(pcm, np.int16).tobytes())
+    return buf.getvalue()
+
+
+class HTTPASRClient:
+    """POSTs WAV chunks to an OpenAI-compatible /v1/audio/transcriptions
+    endpoint (the Riva-replacement seam; any Whisper server works)."""
+
+    def __init__(self, base_url: str, model: str = "whisper-1",
+                 api_key: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.api_key = api_key
+
+    def transcribe(self, pcm: np.ndarray, sample_rate: int) -> str:
+        import requests
+
+        headers = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        files = {"file": ("chunk.wav", pcm_to_wav_bytes(pcm, sample_rate),
+                          "audio/wav")}
+        r = requests.post(f"{self.base_url}/v1/audio/transcriptions",
+                          headers=headers, files=files,
+                          data={"model": self.model}, timeout=60)
+        r.raise_for_status()
+        return r.json().get("text", "")
+
+
+class HTTPTTSClient:
+    """POSTs text to an OpenAI-compatible /v1/audio/speech endpoint and
+    decodes the WAV reply (tts_utils.py:77-127 role)."""
+
+    def __init__(self, base_url: str, model: str = "tts-1",
+                 voice: str = "alloy", api_key: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.voice = voice
+        self.api_key = api_key
+
+    def synthesize(self, text: str, sample_rate: int = 22050) -> np.ndarray:
+        import requests
+
+        headers = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        r = requests.post(f"{self.base_url}/v1/audio/speech",
+                          headers=headers,
+                          json={"model": self.model, "voice": self.voice,
+                                "input": text,
+                                "response_format": "wav"}, timeout=120)
+        r.raise_for_status()
+        with wave.open(io.BytesIO(r.content), "rb") as w:
+            got_rate = w.getframerate()
+            frames = w.readframes(w.getnframes())
+        pcm = np.frombuffer(frames, np.int16)
+        if got_rate != sample_rate:
+            # Endpoints pick their own rate (commonly 24 kHz) — resample
+            # so callers get the rate they asked for.
+            from generativeaiexamples_tpu.streaming import dsp
+
+            audio = np.asarray(pcm, np.float32) / 32768.0
+            audio = np.asarray(dsp.resample_poly(audio, sample_rate,
+                                                 got_rate))
+            pcm = np.asarray(dsp.float_to_pcm(np.clip(audio, -1.0, 1.0)))
+        return pcm
+
+
+class FakeASR:
+    """Scripted transcription: returns the next transcript line per
+    non-silent chunk. Drives hermetic end-to-end streaming tests."""
+
+    def __init__(self, script: Optional[List[str]] = None,
+                 silence_threshold: int = 50):
+        self.script = list(script or [])
+        self.silence_threshold = silence_threshold
+        self.calls = 0
+
+    def transcribe(self, pcm: np.ndarray, sample_rate: int) -> str:
+        self.calls += 1
+        if np.abs(np.asarray(pcm, np.int32)).mean() < self.silence_threshold:
+            return ""
+        return self.script.pop(0) if self.script else ""
+
+
+class FakeTTS:
+    """Deterministic tone-per-word synthesis for tests."""
+
+    def synthesize(self, text: str, sample_rate: int = 22050) -> np.ndarray:
+        n_words = max(1, len(text.split()))
+        t = np.arange(int(0.05 * n_words * sample_rate)) / sample_rate
+        return (np.sin(2 * np.pi * 440.0 * t) * 16000).astype(np.int16)

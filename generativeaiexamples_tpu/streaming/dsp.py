@@ -1,0 +1,137 @@
+"""JAX signal ops for the SDR -> audio front-end.
+
+TPU/jit-native port of the reference's CuPy/cusignal Holoscan operators
+(experimental/fm-asr-streaming-rag/sdr-holoscan/operators.py:43-352) and
+the file-replay modulator (file-replay/wav_replay.py:106-122):
+
+- firwin            Hamming-window FIR design (cusignal.firwin role)
+- fir_filter        causal FIR filtering (lfilter(taps, [1], x) role)
+- fm_demod          phase-unwrap discrete differentiator (operators.py:43)
+- resample_poly     polyphase-equivalent rational resampler (ResampleOp)
+- float_to_pcm      float audio -> int16 PCM (operators.py:64-74)
+- fm_modulate       audio -> complex baseband FM (wav_replay.py:106-122)
+
+Everything is shape-static and jittable: a fixed-size chunk pipeline
+compiles once and streams (the reference "JIT compiles" each CuPy op
+with a warmup call for the same reason, operators.py:210-216).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def firwin(numtaps: int, cutoff: float, fs: float = 2.0) -> jax.Array:
+    """Hamming-windowed sinc lowpass, unity DC gain (cusignal.firwin
+    defaults used by LowPassFilterOp, operators.py:228-231)."""
+    nyq = fs / 2.0
+    fc = cutoff / nyq
+    n = np.arange(numtaps) - (numtaps - 1) / 2.0
+    h = np.sinc(fc * n) * fc
+    w = np.hamming(numtaps)
+    taps = h * w
+    return jnp.asarray(taps / taps.sum(), jnp.float32)
+
+
+@jax.jit
+def fir_filter(taps: jax.Array, x: jax.Array) -> jax.Array:
+    """Causal FIR filter: y[n] = sum_k taps[k] x[n-k], same length as x
+    (lfilter(taps, [1], x), operators.py:54-55). Complex-safe."""
+    T = taps.shape[0]
+    if jnp.iscomplexobj(x):
+        re = jnp.convolve(x.real, taps, mode="full")[: x.shape[0]]
+        im = jnp.convolve(x.imag, taps, mode="full")[: x.shape[0]]
+        return (re + 1j * im).astype(x.dtype)
+    return jnp.convolve(x, taps, mode="full")[: x.shape[0]].astype(x.dtype)
+
+
+@jax.jit
+def fm_demod(x: jax.Array) -> jax.Array:
+    """Demodulate FM: unwrap the instantaneous phase and differentiate
+    (operators.py:43-51). Input must be complex baseband."""
+    angle = jnp.unwrap(jnp.angle(x), axis=-1)
+    return jnp.diff(angle, axis=-1)
+
+
+def _resample_filter(up: int, down: int, ntaps_per_phase: int = 16
+                     ) -> jax.Array:
+    """Anti-aliasing lowpass at the tighter of the two Nyquists, gain
+    `up` (scipy/cusignal resample_poly's filter choice)."""
+    max_rate = max(up, down)
+    numtaps = 2 * ntaps_per_phase * max_rate + 1
+    return firwin(numtaps, 1.0 / max_rate, fs=2.0) * up
+
+
+@functools.partial(jax.jit, static_argnames=("up", "down"))
+def _resample_apply(x: jax.Array, taps: jax.Array, up: int, down: int
+                    ) -> jax.Array:
+    n = x.shape[0]
+    up_len = n * up
+    xs = jnp.zeros((up_len,), x.dtype).at[::up].set(x)
+    # Center the FIR group delay so output aligns with the input grid.
+    delay = (taps.shape[0] - 1) // 2
+    y = jnp.convolve(xs, taps.astype(x.dtype), mode="full")
+    y = y[delay: delay + up_len]
+    return y[::down]
+
+
+def resample_poly(x: jax.Array, up: int, down: int) -> jax.Array:
+    """Rational-rate resampler (ResampleOp, operators.py:277-320).
+    Output length = ceil(len(x) * up / down)."""
+    g = math.gcd(up, down)
+    up, down = up // g, down // g
+    if up == 1 and down == 1:
+        return x
+    taps = _resample_filter(up, down)
+    return _resample_apply(x, taps, up, down)
+
+
+@jax.jit
+def float_to_pcm(f_data: jax.Array) -> jax.Array:
+    """Float audio in [-1, 1] -> int16 PCM (operators.py:64-74)."""
+    info_max, info_min = 32767, -32768
+    scaled = f_data * 32768.0
+    return jnp.clip(scaled, info_min, info_max).astype(jnp.int16)
+
+
+@jax.jit
+def pcm_to_float(pcm: jax.Array) -> jax.Array:
+    return pcm.astype(jnp.float32) / 32768.0
+
+
+def fm_modulate(audio: jax.Array, fs_in: int, fs_out: int,
+                deviation: float = 100_000.0) -> jax.Array:
+    """Audio -> complex baseband FM IQ at fs_out (wav_replay.py:106-122):
+    resample, integrate, frequency-modulate."""
+    x = resample_poly(jnp.asarray(audio, jnp.float32), fs_out, fs_in)
+    integrated = jnp.cumsum(x) / fs_out
+    phase = 2.0 * jnp.pi * deviation * integrated
+    return (jnp.cos(phase) + 1j * jnp.sin(phase)).astype(jnp.complex64)
+
+
+class FMReceiver:
+    """The demod chain SDR pipeline: lowpass -> fm_demod -> resample ->
+    PCM (operators.py LowPassFilterOp -> DemodulateOp -> ResampleOp).
+    Chunk-shape static; jit-compiled once per chunk size."""
+
+    def __init__(self, fs_in: int, fs_audio: int = 16_000,
+                 cutoff: float = 100_000.0, numtaps: int = 65,
+                 gain: float = 4.0):
+        self.fs_in = fs_in
+        self.fs_audio = fs_audio
+        self.taps = firwin(numtaps, cutoff, fs=fs_in)
+        self.gain = gain
+
+    def process(self, iq_chunk: jax.Array) -> jax.Array:
+        """IQ baseband chunk -> int16 PCM audio at fs_audio."""
+        filtered = fir_filter(self.taps, jnp.asarray(iq_chunk))
+        demod = fm_demod(filtered)
+        audio = resample_poly(demod, self.fs_audio, self.fs_in)
+        # Normalize the FM discriminator slope to unit audio amplitude.
+        audio = audio * (self.gain * self.fs_in / (2 * jnp.pi * 100_000.0))
+        return float_to_pcm(jnp.clip(audio, -1.0, 1.0))

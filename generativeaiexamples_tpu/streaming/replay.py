@@ -1,0 +1,116 @@
+"""File-replay fake signal source + stream pump.
+
+Port of the reference's file-replay container
+(experimental/fm-asr-streaming-rag/file-replay/wav_replay.py:106-168):
+FM-modulate audio and feed it into the receive pipeline in chunks, so
+the whole SDR -> demod -> ASR -> accumulator path runs without radio
+hardware. Supports in-process delivery (hermetic tests) and UDP packets
+(parity with the reference's BasicNetworkRxOp ingest,
+sdr-holoscan/operators.py:77-140).
+"""
+
+from __future__ import annotations
+
+import socket
+import wave
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from generativeaiexamples_tpu.streaming import dsp
+
+
+def load_wav(path: str) -> tuple[np.ndarray, int]:
+    """Mono float audio in [-1, 1] + sample rate, stdlib only."""
+    with wave.open(path, "rb") as w:
+        fs = w.getframerate()
+        n = w.getnframes()
+        raw = np.frombuffer(w.readframes(n), np.int16)
+        if w.getnchannels() > 1:
+            raw = raw.reshape(-1, w.getnchannels()).mean(axis=1)
+    return np.asarray(raw, np.float32) / 32768.0, fs
+
+
+def synth_speech_like(duration_s: float, fs: int = 16_000,
+                      seed: int = 0) -> np.ndarray:
+    """Synthetic non-silent audio (band-limited noise bursts) — the
+    test-corpus stand-in for a WAV file."""
+    rng = np.random.default_rng(seed)
+    n = int(duration_s * fs)
+    x = rng.standard_normal(n).astype(np.float32)
+    # Crude band-limit: moving average -> speech-ish spectrum.
+    kernel = np.hamming(9).astype(np.float32)
+    x = np.convolve(x, kernel / kernel.sum(), mode="same")
+    return 0.5 * x / max(1e-6, np.abs(x).max())
+
+
+def iq_chunks(audio: np.ndarray, fs_in: int, fs_iq: int,
+              chunk_time: float = 1.0) -> Iterator[np.ndarray]:
+    """FM-modulate audio and yield fixed-size IQ chunks
+    (wav_replay.py:126-160's streaming loop, minus the socket)."""
+    samples = np.asarray(dsp.fm_modulate(audio, fs_in, fs_iq))
+    chunk = int(fs_iq * chunk_time)
+    for i in range(0, len(samples) - chunk + 1, chunk):
+        yield samples[i: i + chunk]
+    tail = len(samples) % chunk
+    if tail:
+        yield np.pad(samples[-tail:], (0, chunk - tail))
+
+
+def udp_send_iq(samples: np.ndarray, dst: tuple, pkt_size: int = 4096
+                ) -> int:
+    """Send complex64 IQ over UDP (wav_replay.py:124-139). Returns the
+    number of packets sent."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    data = np.asarray(samples, np.complex64).tobytes()
+    sent = 0
+    for i in range(0, len(data), pkt_size):
+        sock.sendto(data[i: i + pkt_size], dst)
+        sent += 1
+    sock.close()
+    return sent
+
+
+def udp_receive_iq(port: int, n_bytes: int, host: str = "127.0.0.1",
+                   timeout: float = 5.0) -> np.ndarray:
+    """Collect n_bytes of IQ from UDP (BasicNetworkRxOp role)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind((host, port))
+    sock.settimeout(timeout)
+    chunks = []
+    got = 0
+    try:
+        while got < n_bytes:
+            pkt, _ = sock.recvfrom(65536)
+            chunks.append(pkt)
+            got += len(pkt)
+    finally:
+        sock.close()
+    return np.frombuffer(b"".join(chunks)[:n_bytes], np.complex64)
+
+
+class StreamPump:
+    """Drive audio through modulate -> receive -> ASR -> sink; the
+    in-process equivalent of the reference's three containers
+    (file-replay -> sdr-holoscan -> chain server POST loop)."""
+
+    def __init__(self, asr, on_transcript: Callable[[str, str], None],
+                 fs_audio: int = 16_000, fs_iq: int = 250_000,
+                 source_id: str = "replay"):
+        self.asr = asr
+        self.on_transcript = on_transcript
+        self.fs_audio = fs_audio
+        self.fs_iq = fs_iq
+        self.source_id = source_id
+        self.receiver = dsp.FMReceiver(fs_in=fs_iq, fs_audio=fs_audio)
+
+    def run(self, audio: np.ndarray, chunk_time: float = 1.0) -> int:
+        """Returns the number of non-empty transcripts delivered."""
+        delivered = 0
+        for iq in iq_chunks(audio, self.fs_audio, self.fs_iq, chunk_time):
+            pcm = np.asarray(self.receiver.process(iq))
+            text = self.asr.transcribe(pcm, self.fs_audio)
+            if text:
+                self.on_transcript(self.source_id, text)
+                delivered += 1
+        return delivered

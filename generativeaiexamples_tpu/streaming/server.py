@@ -1,0 +1,117 @@
+"""Streaming chain server: /storeStreamingText + intent-routed /generate.
+
+REST parity with the reference fm-asr chain server
+(experimental/fm-asr-streaming-rag/chain-server/server.py:34-70):
+POST /storeStreamingText ingests transcript fragments, GET /serverStatus
+reports readiness, POST /generate streams an intent-routed answer (the
+reference uses GET-with-body; POST here). Runs standalone
+(`python -m generativeaiexamples_tpu.streaming`) against the in-process
+TPU engines or any OpenAI-compatible endpoint via the connector factory.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from generativeaiexamples_tpu.streaming.accumulator import (
+    StreamingStore, TextAccumulator)
+from generativeaiexamples_tpu.streaming.chains import StreamingRagChain
+
+_LOG = logging.getLogger(__name__)
+
+
+class StreamingServer:
+    def __init__(self, llm, embedder, *, chunk_size: int = 256,
+                 chunk_overlap: int = 32, max_docs: int = 4,
+                 allow_summary: bool = True,
+                 timestamp_db_path: str = ":memory:"):
+        from generativeaiexamples_tpu.streaming.timestamps import (
+            TimestampDatabase)
+
+        self.llm = llm
+        self.store = StreamingStore(embedder)
+        self.accumulator = TextAccumulator(
+            self.store, chunk_size=chunk_size, chunk_overlap=chunk_overlap,
+            timestamp_db=TimestampDatabase(timestamp_db_path))
+        self.max_docs = max_docs
+        self.allow_summary = allow_summary
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/serverStatus", self.handle_status),
+            web.post("/storeStreamingText", self.handle_store),
+            web.post("/generate", self.handle_generate),
+        ])
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_ready": True})
+
+    async def handle_store(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"detail": "invalid JSON"}, status=422)
+        transcript = body.get("transcript", "")
+        source_id = body.get("source_id", "default")
+        if not transcript:
+            return web.json_response({"detail": "transcript required"},
+                                     status=422)
+        import asyncio
+
+        out = await asyncio.to_thread(self.accumulator.update, source_id,
+                                      transcript)
+        return web.json_response(out)
+
+    async def handle_generate(self, request: web.Request
+                              ) -> web.StreamResponse:
+        import asyncio
+
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"detail": "invalid JSON"}, status=422)
+        question = body.get("question", "")
+        if not question:
+            return web.json_response({"detail": "question required"},
+                                     status=422)
+        chain = StreamingRagChain(
+            self.llm, self.accumulator, self.store, max_docs=self.max_docs,
+            allow_summary=bool(body.get("allow_summary",
+                                        self.allow_summary)))
+        from generativeaiexamples_tpu.utils.sse import stream_sse
+
+        return await stream_sse(
+            request,
+            lambda: chain.answer(
+                question,
+                use_knowledge_base=bool(
+                    body.get("use_knowledge_base", True))),
+            final_payload=lambda: {"done": True})
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8100)
+    ap.add_argument("--config", default=None)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from generativeaiexamples_tpu.config.wizard import load_config
+    from generativeaiexamples_tpu.connectors.factory import (
+        get_embedder, get_llm)
+
+    cfg = load_config(args.config)
+    server = StreamingServer(get_llm(cfg), get_embedder(cfg))
+    _LOG.info("streaming chain server on %s:%d", args.host, args.port)
+    web.run_app(server.app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,44 +75,19 @@ class PlaygroundServer:
         use_kb = bool(body.get("use_knowledge_base", False))
         num_tokens = int(body.get("max_tokens", 1024))
 
-        resp = web.StreamResponse(headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-        })
-        await resp.prepare(request)
-
         docs = []
         if use_kb:
             docs = await asyncio.to_thread(self.client.search, query)
 
-        loop = asyncio.get_running_loop()
-        queue: asyncio.Queue = asyncio.Queue()
+        from generativeaiexamples_tpu.utils.sse import stream_sse
 
-        def pump():
-            try:
-                for chunk in self.client.predict(query, use_kb,
-                                                 num_tokens=num_tokens):
-                    loop.call_soon_threadsafe(queue.put_nowait, chunk)
-            except Exception as e:  # surface, don't hang the stream
-                _LOG.exception("predict pump failed")
-                loop.call_soon_threadsafe(queue.put_nowait, f"[error] {e}")
-                loop.call_soon_threadsafe(queue.put_nowait, None)
-
-        task = asyncio.get_running_loop().run_in_executor(None, pump)
-        try:
-            while True:
-                chunk = await queue.get()
-                if chunk is None:
-                    break
-                await resp.write(
-                    b"data: " + json.dumps({"content": chunk}).encode()
-                    + b"\n\n")
-            await resp.write(
-                b"data: " + json.dumps({"done": True, "context": docs}).encode()
-                + b"\n\n")
-        finally:
-            await task
-        return resp
+        return await stream_sse(
+            request,
+            lambda: self.client.predict(query, use_kb,
+                                        num_tokens=num_tokens),
+            # predict yields None as its own end sentinel — skip it.
+            map_item=lambda c: {"content": c} if c else None,
+            final_payload=lambda: {"done": True, "context": docs})
 
     async def handle_search(self, request: web.Request) -> web.Response:
         body = await request.json()
