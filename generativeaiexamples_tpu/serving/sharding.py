@@ -93,15 +93,6 @@ def shard_llama_params(params, cfg: LlamaConfig, mesh: Mesh, rules=None):
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-def shard_pool(pool, mesh: Mesh):
-    """Place a PagePool's k/v on the mesh (kv-heads on tensor)."""
-    from generativeaiexamples_tpu.serving.kv_cache import PagePool
-
-    s = NamedSharding(mesh, KV_POOL_SPEC)
-    return PagePool(jax.device_put(pool.k, s), jax.device_put(pool.v, s),
-                    pool.page_size)
-
-
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

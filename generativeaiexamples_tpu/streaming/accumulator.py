@@ -26,7 +26,8 @@ class StreamingStore:
         from generativeaiexamples_tpu.rag.vectorstore import MemoryVectorStore
 
         self.embedder = embedder
-        dim = len(np.asarray(embedder.embed_query("probe")).ravel())
+        dim = getattr(embedder, "dim", None) or len(
+            np.asarray(embedder.embed_query("probe")).ravel())
         self.store = store if store is not None else MemoryVectorStore(dim)
 
     def add_docs(self, docs, source_id: str) -> None:

@@ -58,6 +58,7 @@ def fm_demod(x: jax.Array) -> jax.Array:
     return jnp.diff(angle, axis=-1)
 
 
+@functools.lru_cache(maxsize=64)
 def _resample_filter(up: int, down: int, ntaps_per_phase: int = 16
                      ) -> jax.Array:
     """Anti-aliasing lowpass at the tighter of the two Nyquists, gain

@@ -43,6 +43,7 @@ class StreamingServer:
         self.app.add_routes([
             web.get("/serverStatus", self.handle_status),
             web.post("/storeStreamingText", self.handle_store),
+            web.post("/flush", self.handle_flush),
             web.post("/generate", self.handle_generate),
         ])
 
@@ -50,28 +51,52 @@ class StreamingServer:
         return web.json_response({"is_ready": True})
 
     async def handle_store(self, request: web.Request) -> web.Response:
-        try:
-            body = await request.json()
-        except json.JSONDecodeError:
+        body = await self._json_body(request)
+        if body is None:
             return web.json_response({"detail": "invalid JSON"}, status=422)
         transcript = body.get("transcript", "")
         source_id = body.get("source_id", "default")
-        if not transcript:
+        end_of_stream = bool(body.get("end_of_stream", False))
+        if not transcript and not end_of_stream:
             return web.json_response({"detail": "transcript required"},
                                      status=422)
         import asyncio
 
-        out = await asyncio.to_thread(self.accumulator.update, source_id,
-                                      transcript)
+        out = {"status": "Added 0 entries"}
+        if transcript:
+            out = await asyncio.to_thread(self.accumulator.update, source_id,
+                                          transcript)
+        if end_of_stream:
+            flushed = await asyncio.to_thread(self.accumulator.flush,
+                                              source_id)
+            out["flushed"] = flushed
         return web.json_response(out)
 
-    async def handle_generate(self, request: web.Request
-                              ) -> web.StreamResponse:
+    async def handle_flush(self, request: web.Request) -> web.Response:
+        """Flush a source's tail buffer (stream ended). The reference
+        leaves the final sub-chunk fragment stranded; this makes stream
+        end explicit."""
+        body = await self._json_body(request)
+        if body is None:
+            return web.json_response({"detail": "invalid JSON"}, status=422)
         import asyncio
 
+        flushed = await asyncio.to_thread(
+            self.accumulator.flush, body.get("source_id", "default"))
+        return web.json_response({"flushed": flushed})
+
+    @staticmethod
+    async def _json_body(request: web.Request):
         try:
             body = await request.json()
         except json.JSONDecodeError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    async def handle_generate(self, request: web.Request
+                              ) -> web.StreamResponse:
+        body = await self._json_body(request)
+        if body is None:
             return web.json_response({"detail": "invalid JSON"}, status=422)
         question = body.get("question", "")
         if not question:

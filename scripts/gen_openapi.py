@@ -1,0 +1,232 @@
+"""Generate the chain server's OpenAPI schema artifact.
+
+The reference checks a FastAPI-generated schema into
+docs/api_reference/openapi_schema.json to pin the REST surface; the
+aiohttp server here has no auto-generation, so the schema is authored in
+code (one source of truth, asserted current by tests/test_openapi.py)
+and written to the same path. Same four paths, same model names.
+
+Usage: python scripts/gen_openapi.py [--check]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "docs", "api_reference", "openapi_schema.json")
+
+_VALIDATION = {
+    "HTTPValidationError": {
+        "type": "object", "title": "HTTPValidationError",
+        "properties": {"detail": {"type": "string", "title": "Detail"}},
+    },
+}
+
+
+def build_schema() -> dict:
+    message = {
+        "type": "object", "title": "Message",
+        "description": "A chat turn (role + sanitized content).",
+        "required": ["role", "content"],
+        "properties": {
+            "role": {"type": "string", "title": "Role",
+                     "description": "user | assistant | system"},
+            "content": {"type": "string", "title": "Content",
+                        "maxLength": 131072},
+        },
+    }
+    prompt = {
+        "type": "object", "title": "Prompt",
+        "description": "Generation request (reference common/server.py:75-105).",
+        "required": ["messages"],
+        "properties": {
+            "messages": {"type": "array", "title": "Messages",
+                         "items": {"$ref": "#/components/schemas/Message"}},
+            "use_knowledge_base": {"type": "boolean", "default": False},
+            "temperature": {"type": "number", "default": 0.2,
+                            "minimum": 0.0, "maximum": 1.0},
+            "top_p": {"type": "number", "default": 0.7,
+                      "minimum": 0.1, "maximum": 1.0},
+            "max_tokens": {"type": "integer", "default": 1024,
+                           "maximum": 1024},
+            "stop": {"type": "array", "items": {"type": "string"},
+                     "default": []},
+        },
+    }
+    chain_choices = {
+        "type": "object", "title": "ChainResponseChoices",
+        "properties": {
+            "index": {"type": "integer", "default": 0},
+            "message": {"$ref": "#/components/schemas/Message"},
+            "finish_reason": {"type": "string", "default": "",
+                              "description": "'' while streaming; "
+                                             "'[DONE]' on the final frame"},
+        },
+    }
+    chain_response = {
+        "type": "object", "title": "ChainResponse",
+        "description": "One SSE frame of /generate "
+                       "(data: <ChainResponse-json>).",
+        "properties": {
+            "id": {"type": "string", "default": ""},
+            "choices": {"type": "array",
+                        "items": {"$ref":
+                                  "#/components/schemas/ChainResponseChoices"}},
+        },
+    }
+    document_search = {
+        "type": "object", "title": "DocumentSearch",
+        "required": ["query"],
+        "properties": {
+            "query": {"type": "string", "maxLength": 131072},
+            "top_k": {"type": "integer", "default": 4},
+        },
+    }
+    document_chunk = {
+        "type": "object", "title": "DocumentChunk",
+        "properties": {
+            "content": {"type": "string"},
+            "filename": {"type": "string"},
+            "score": {"type": "number"},
+        },
+    }
+    return {
+        "openapi": "3.1.0",
+        "info": {"title": "Chain Server (TPU)",
+                 "description": "REST surface of the TPU-native chain "
+                                "server; field-for-field parity with the "
+                                "reference openapi_schema.json.",
+                 "version": "0.7.0"},
+        "paths": {
+            "/health": {
+                "get": {
+                    "summary": "Health Check",
+                    "operationId": "health_check_health_get",
+                    "responses": {"200": {
+                        "description": "Service is up.",
+                        "content": {"application/json": {"schema": {
+                            "$ref": "#/components/schemas/HealthResponse"}}},
+                    }},
+                },
+            },
+            "/generate": {
+                "post": {
+                    "summary": "Generate Answer",
+                    "description": "SSE stream of ChainResponse frames, "
+                                   "terminated by finish_reason='[DONE]'.",
+                    "operationId": "generate_answer_generate_post",
+                    "requestBody": {"required": True, "content": {
+                        "application/json": {"schema": {
+                            "$ref": "#/components/schemas/Prompt"}}}},
+                    "responses": {
+                        "200": {"description": "token stream",
+                                "content": {"text/event-stream": {}}},
+                        "422": {"description": "Validation Error",
+                                "content": {"application/json": {"schema": {
+                                    "$ref": "#/components/schemas/"
+                                            "HTTPValidationError"}}}},
+                    },
+                },
+            },
+            "/documents": {
+                "post": {
+                    "summary": "Upload Document",
+                    "operationId": "upload_document_documents_post",
+                    "requestBody": {"required": True, "content": {
+                        "multipart/form-data": {"schema": {
+                            "type": "object", "required": ["file"],
+                            "properties": {"file": {
+                                "type": "string", "format": "binary"}}}}}},
+                    "responses": {
+                        "200": {"description": "uploaded"},
+                        "422": {"description": "Validation Error"},
+                        "500": {"description": "ingestion failed"},
+                    },
+                },
+                "get": {
+                    "summary": "Get Documents",
+                    "operationId": "get_documents_documents_get",
+                    "responses": {"200": {
+                        "description": "uploaded document names",
+                        "content": {"application/json": {"schema": {
+                            "$ref": "#/components/schemas/"
+                                    "DocumentsResponse"}}}}},
+                },
+                "delete": {
+                    "summary": "Delete Document",
+                    "operationId": "delete_document_documents_delete",
+                    "parameters": [{"name": "filename", "in": "query",
+                                    "required": True,
+                                    "schema": {"type": "string"}}],
+                    "responses": {
+                        "200": {"description": "deleted"},
+                        "404": {"description": "not found"},
+                        "422": {"description": "Validation Error"},
+                    },
+                },
+            },
+            "/search": {
+                "post": {
+                    "summary": "Document Search",
+                    "operationId": "document_search_search_post",
+                    "requestBody": {"required": True, "content": {
+                        "application/json": {"schema": {
+                            "$ref": "#/components/schemas/DocumentSearch"}}}},
+                    "responses": {
+                        "200": {"description": "top-k chunks",
+                                "content": {"application/json": {"schema": {
+                                    "$ref": "#/components/schemas/"
+                                            "DocumentSearchResponse"}}}},
+                        "422": {"description": "Validation Error"},
+                    },
+                },
+            },
+        },
+        "components": {"schemas": {
+            "Message": message,
+            "Prompt": prompt,
+            "ChainResponse": chain_response,
+            "ChainResponseChoices": chain_choices,
+            "DocumentSearch": document_search,
+            "DocumentChunk": document_chunk,
+            "DocumentSearchResponse": {
+                "type": "object", "title": "DocumentSearchResponse",
+                "properties": {"chunks": {
+                    "type": "array",
+                    "items": {"$ref": "#/components/schemas/DocumentChunk"}}},
+            },
+            "DocumentsResponse": {
+                "type": "object", "title": "DocumentsResponse",
+                "properties": {"documents": {
+                    "type": "array", "items": {"type": "string"}}},
+            },
+            "HealthResponse": {
+                "type": "object", "title": "HealthResponse",
+                "properties": {"message": {"type": "string", "default": ""}},
+            },
+            **_VALIDATION,
+        }},
+    }
+
+
+def main() -> int:
+    schema = json.dumps(build_schema(), indent=2) + "\n"
+    if "--check" in sys.argv:
+        with open(OUT) as fh:
+            if fh.read() != schema:
+                print("openapi schema is stale; run scripts/gen_openapi.py",
+                      file=sys.stderr)
+                return 1
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as fh:
+        fh.write(schema)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,41 @@
+"""The checked-in OpenAPI artifact stays true: regenerating produces the
+same bytes, and every documented path/verb exists on the live server
+(the reference pins its surface the same way,
+docs/api_reference/openapi_schema.json)."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = os.path.join(ROOT, "docs", "api_reference", "openapi_schema.json")
+
+
+def test_schema_artifact_is_current():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "gen_openapi.py"),
+         "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+
+
+def test_documented_routes_exist_on_server():
+    from generativeaiexamples_tpu.api.server import ChainServer
+    from generativeaiexamples_tpu.config.wizard import load_config
+    from generativeaiexamples_tpu.connectors.fakes import EchoLLM, HashEmbedder
+    from generativeaiexamples_tpu.pipelines.base import get_example_class
+    from generativeaiexamples_tpu.pipelines.resources import Resources
+
+    cfg = load_config(path="", env={})
+    res = Resources(cfg, llm=EchoLLM(), embedder=HashEmbedder(8),
+                    reranker=None)
+    srv = ChainServer(cfg, example=get_example_class("developer_rag")(res))
+
+    served = {(r.resource.canonical, r.method.lower())
+              for r in srv.app.router.routes()
+              if r.method.lower() != "head"}
+    with open(SCHEMA) as fh:
+        spec = json.load(fh)
+    for path, verbs in spec["paths"].items():
+        for verb in verbs:
+            assert (path, verb) in served, f"{verb.upper()} {path} not served"

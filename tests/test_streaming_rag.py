@@ -270,6 +270,19 @@ class TestStreamingServer:
                 assert "Added" in (await r.json())["status"]
                 r = await client.post("/storeStreamingText", json={})
                 assert r.status == 422
+                # valid JSON but not an object -> 422, not a 500
+                r = await client.post(
+                    "/storeStreamingText", data='"hello"',
+                    headers={"Content-Type": "application/json"})
+                assert r.status == 422
+                # stream end flushes the tail buffer
+                r = await client.post("/flush", json={"source_id": "fm"})
+                assert r.status == 200
+                assert (await r.json())["flushed"] >= 0
+                r = await client.post("/storeStreamingText", json={
+                    "transcript": "final words", "source_id": "fm",
+                    "end_of_stream": True})
+                assert (await r.json())["flushed"] == 1
                 r = await client.post("/generate", json={
                     "question": "what about the reactor?"})
                 assert r.status == 200
