@@ -1,0 +1,17 @@
+"""Knowledge-graph RAG: triple extraction, entity graph, eval router.
+
+TPU-native port of the reference's knowledge_graph_rag experimental
+backend (experimental/knowledge_graph_rag/backend/): LLM triple
+extraction over document chunks (utils/preprocessor.py:51-82), an
+in-process entity graph with depth-bounded neighborhood expansion
+(LangChain NetworkxEntityGraph role), graph+vector combined answering
+(routers/chat.py:35-70), and the text-vs-graph-vs-combined evaluation
+router (routers/evaluation.py:57-260) on top of the existing eval
+harness.
+"""
+
+from generativeaiexamples_tpu.kg.extraction import (
+    extract_triples, process_documents)
+from generativeaiexamples_tpu.kg.graph import EntityGraph, Triple
+
+__all__ = ["EntityGraph", "Triple", "extract_triples", "process_documents"]

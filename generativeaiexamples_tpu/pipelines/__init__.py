@@ -4,5 +4,5 @@ Importing this package registers the built-in examples.
 """
 
 from generativeaiexamples_tpu.pipelines import (  # noqa: F401
-    api_catalog, developer_rag, multi_turn_rag, multimodal,
+    api_catalog, developer_rag, knowledge_graph, multi_turn_rag, multimodal,
     query_decomposition, structured_data)
