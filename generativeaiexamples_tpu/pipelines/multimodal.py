@@ -4,15 +4,22 @@ pdfplumber layout + OCR + Neva chart detection + DePlot chart->table,
 
 Structure kept, engines swapped for what this environment provides:
 - text: utils.pdf pure-Python extractor (pdfplumber role)
-- tables: whitespace-column heuristic over text lines (layout role)
-- images: embedded JPEG extraction; each image runs through the VLM
+- tables: PDF layout analysis — positioned text runs clustered into
+  row/column grids (utils.layout, the pdfplumber-table role); plain-text
+  inputs fall back to the whitespace-column heuristic.
+- PPTX: parsed natively from DrawingML XML (utils.pptx) — slide text,
+  explicit a:tbl tables, speaker notes, embedded images. The reference
+  shells out to LibreOffice for a PPT->PDF->images detour
+  (custom_powerpoint_parser.py:25-46); native parsing keeps tables as
+  tables instead of rasterizing them.
+- images (PDF-embedded or PPTX media): each runs through the VLM
   connector when configured — chart? -> chart_to_table (DePlot role),
   else a description (Neva role). No VLM -> images are skipped, text and
   tables still ingest (graceful degradation, reference behavior when its
   VLM endpoints are down).
 - chunks carry a `content_type` tag ({text|table|image}) like the
   reference's Milvus schema (retriever/vector.py:45-80), surfaced in the
-  RAG context header.
+  RAG context header and filterable in document_search.
 """
 
 from __future__ import annotations
@@ -28,6 +35,15 @@ from generativeaiexamples_tpu.rag.splitter import RecursiveCharacterSplitter
 _LOG = logging.getLogger(__name__)
 
 _TABLE_ROW = re.compile(r"\S+(?:\s{2,}\S+){2,}")  # >=3 columns
+
+
+def enrich_image(vlm, data: bytes, fmt: str) -> str:
+    """One image through the VLM seam: chart -> linearized table
+    (DePlot role), else description (Neva role). Shared by the PDF and
+    PPTX ingest paths so the behavior can't drift."""
+    if vlm.is_chart(data, fmt):
+        return "Chart data table:\n" + vlm.chart_to_table(data, fmt)
+    return vlm.describe(data, "Describe this image in detail.", fmt)
 
 
 def find_tables(text: str) -> List[str]:
@@ -55,21 +71,13 @@ class MultimodalRAG(QAChatbot):
         return self.res.extras["vlm"]
 
     def ingest_docs(self, filepath: str, filename: str) -> None:
-        from generativeaiexamples_tpu.rag.documents import load_document
-
+        lower = filepath.lower()
         chunks: List[str] = []
         metas: List[Dict] = []
-        splitter = RecursiveCharacterSplitter(1000, 100)  # multimodal split
-        docs = load_document(filepath, filename)
-        full_text = "\n".join(d.text for d in docs)
-        for c in splitter.split(full_text):
-            chunks.append(c)
-            metas.append({"filename": filename, "content_type": "text"})
-        for t in find_tables(full_text):
-            chunks.append(t)
-            metas.append({"filename": filename, "content_type": "table"})
-        if filepath.lower().endswith(".pdf"):
-            self._ingest_pdf_images(filepath, filename, chunks, metas)
+        if lower.endswith((".pptx", ".ppt")):
+            self._ingest_pptx(filepath, filename, chunks, metas)
+        else:
+            self._ingest_document(filepath, filename, chunks, metas)
         if not chunks:
             raise ValueError(f"no extractable content in {filename}")
         embs = self.res.embedder.embed_documents(chunks)
@@ -78,6 +86,77 @@ class MultimodalRAG(QAChatbot):
                   filename, len(chunks),
                   sum(m["content_type"] == "table" for m in metas),
                   sum(m["content_type"] == "image" for m in metas))
+
+    def _ingest_document(self, filepath: str, filename: str,
+                         chunks: List[str], metas: List[Dict]) -> None:
+        from generativeaiexamples_tpu.rag.documents import load_document
+
+        splitter = RecursiveCharacterSplitter(1000, 100)  # multimodal split
+        docs = load_document(filepath, filename)
+        full_text = "\n".join(d.text for d in docs)
+        for c in splitter.split(full_text):
+            chunks.append(c)
+            metas.append({"filename": filename, "content_type": "text"})
+        for t in self._document_tables(filepath, full_text):
+            chunks.append(t)
+            metas.append({"filename": filename, "content_type": "table"})
+        if filepath.lower().endswith(".pdf"):
+            self._ingest_pdf_images(filepath, filename, chunks, metas)
+
+    def _document_tables(self, filepath: str, full_text: str) -> List[str]:
+        """Layout-analysis tables for PDFs (positioned runs -> grids);
+        whitespace heuristic for everything else."""
+        if filepath.lower().endswith(".pdf"):
+            from generativeaiexamples_tpu.utils import layout, pdf
+
+            try:
+                return layout.page_tables_as_text(
+                    pdf.extract_words(filepath))
+            except Exception:
+                _LOG.exception("layout analysis failed for %s; falling "
+                               "back to text heuristic", filepath)
+        return find_tables(full_text)
+
+    def _ingest_pptx(self, filepath: str, filename: str,
+                     chunks: List[str], metas: List[Dict]) -> None:
+        """Native PPTX ingestion (reference detours through LibreOffice,
+        custom_powerpoint_parser.py:25-46)."""
+        from generativeaiexamples_tpu.utils.layout import table_to_text
+        from generativeaiexamples_tpu.utils.pptx import parse_pptx
+
+        splitter = RecursiveCharacterSplitter(1000, 100)
+        slides = parse_pptx(filepath)
+        vlm = self._vlm()
+        skipped_images = 0
+        for slide in slides:
+            base = {"filename": filename, "slide": slide.number}
+            text = slide.all_text()
+            if slide.notes:
+                text = f"{text}\nSpeaker notes: {slide.notes}".strip()
+            for c in splitter.split(text):
+                chunks.append(c)
+                metas.append({**base, "content_type": "text"})
+            for grid in slide.tables:
+                chunks.append(table_to_text(grid))
+                metas.append({**base, "content_type": "table"})
+            for i, (name, data) in enumerate(slide.images):
+                if vlm is None:
+                    skipped_images += 1
+                    continue
+                fmt = name.rsplit(".", 1)[-1].lower()
+                try:
+                    desc = enrich_image(vlm, data, fmt)
+                except Exception:
+                    _LOG.exception("VLM enrichment failed for %s on "
+                                   "slide %d", name, slide.number)
+                    continue
+                chunks.append(desc)
+                metas.append({**base, "content_type": "image",
+                              "image_index": i})
+        if skipped_images:
+            _LOG.warning("%s has %d slide images but no VLM endpoint "
+                         "configured; skipping image enrichment",
+                         filename, skipped_images)
 
     def _ingest_pdf_images(self, filepath: str, filename: str,
                            chunks: List[str], metas: List[Dict]) -> None:
@@ -92,12 +171,7 @@ class MultimodalRAG(QAChatbot):
             return
         for i, (fmt, data) in enumerate(images):
             try:
-                if vlm.is_chart(data, fmt):  # DePlot path
-                    desc = ("Chart data table:\n"
-                            + vlm.chart_to_table(data, fmt))
-                else:  # description path
-                    desc = vlm.describe(
-                        data, "Describe this image in detail.", fmt)
+                desc = enrich_image(vlm, data, fmt)
             except Exception:
                 _LOG.exception("VLM enrichment failed for image %d of %s",
                                i, filename)
@@ -105,6 +179,38 @@ class MultimodalRAG(QAChatbot):
             chunks.append(desc)
             metas.append({"filename": filename, "content_type": "image",
                           "image_index": i})
+
+    def document_search(self, content: str, num_docs: int,
+                        content_type: str = "") -> List[Dict]:
+        """Search with an optional content_type filter (text|table|image)
+        — the reference filters on its Milvus content-type field
+        (retriever/vector.py:95-120)."""
+        def fetch(k: int) -> List[Dict]:
+            results = self.res.retriever.retrieve(content, top_k=k,
+                                                  with_threshold=False)
+            out = []
+            for r in results:
+                if content_type and \
+                        r.metadata.get("content_type") != content_type:
+                    continue
+                out.append({"content": r.text,
+                            "filename": r.metadata.get("filename", ""),
+                            "content_type": r.metadata.get("content_type",
+                                                           ""),
+                            "score": r.score})
+                if len(out) >= num_docs:
+                    break
+            return out
+
+        if not content_type:
+            return fetch(num_docs)
+        out = fetch(num_docs * 4)
+        if len(out) < num_docs:
+            # The wanted type may rank below the over-fetch horizon
+            # (e.g. 5 tables among hundreds of text chunks): widen to
+            # the whole store rather than report a false empty.
+            out = fetch(len(self.res.store))
+        return out
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:

@@ -222,3 +222,106 @@ def extract_text(path: str) -> str:
     pdf = _PDF(data)
     pages = [_stream_text(s) for s in pdf.page_content_streams()]
     return "\f".join(p for p in pages if p.strip())
+
+
+# ---------------------------------------------------------------------------
+# Positioned text (layout analysis input)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(
+    rb"\((?P<str>(?:\\.|[^\\()])*)\)|"          # literal string
+    rb"\[(?P<arr>(?:\\.|[^\]])*)\]|"            # array (TJ)
+    rb"<(?P<hex>[0-9A-Fa-f\s]*)>|"              # hex string
+    rb"(?P<num>[-+]?\d*\.?\d+)|"                # number
+    rb"(?P<op>[A-Za-z'\"*]{1,3})", re.S)
+
+
+def _decode_pdf_string(raw: bytes) -> str:
+    return _unescape(raw).decode("latin-1")
+
+
+def _stream_words(payload: bytes) -> List[Tuple[float, float, str]]:
+    """Interpret the text-positioning subset of a content stream:
+    Tm/Td/TD/TL/T* cursor ops and Tj/TJ/'/\" show ops. Returns text runs
+    with their line-start coordinates — the input for layout analysis
+    (pdfplumber's `words` role). Rotation/scaling in Tm is ignored
+    beyond the translation (machine-generated report PDFs are axis-
+    aligned; anything else degrades to unpositioned text elsewhere)."""
+    words: List[Tuple[float, float, str]] = []
+    nums: List[float] = []
+    strings: List[str] = []
+    x = y = 0.0
+    lx = ly = 0.0  # line matrix origin
+    leading = 12.0
+
+    def show(text: str) -> None:
+        if text:
+            words.append((x, y, text))
+
+    for m in _TOKEN.finditer(payload):
+        if m.group("str") is not None:
+            strings.append(_decode_pdf_string(m.group("str")))
+        elif m.group("arr") is not None:
+            parts = [
+                _decode_pdf_string(sm.group(0)[1:-1])
+                for sm in re.finditer(rb"\((?:\\.|[^\\()])*\)",
+                                      m.group("arr"))
+            ]
+            strings.append("".join(parts))
+        elif m.group("hex") is not None:
+            hx = re.sub(rb"\s", b"", m.group("hex"))
+            try:
+                raw = bytes.fromhex(hx.decode())
+                strings.append(raw.decode("utf-16-be")
+                               if raw[:2] == b"\xfe\xff"
+                               else raw.decode("latin-1"))
+            except (ValueError, UnicodeDecodeError):
+                strings.append("")
+        elif m.group("num") is not None:
+            nums.append(float(m.group("num")))
+            continue  # operands accumulate until an operator
+        else:
+            op = m.group("op")
+            if op == b"BT":
+                x = y = lx = ly = 0.0
+            elif op == b"Tm" and len(nums) >= 6:
+                lx, ly = nums[-2], nums[-1]
+                x, y = lx, ly
+            elif op in (b"Td", b"TD") and len(nums) >= 2:
+                lx += nums[-2]
+                ly += nums[-1]
+                x, y = lx, ly
+                if op == b"TD":
+                    leading = -nums[-1] or leading
+            elif op == b"TL" and nums:
+                leading = nums[-1]
+            elif op == b"T*":
+                ly -= leading
+                x, y = lx, ly
+            elif op == b"Tj" and strings:
+                show(strings[-1])
+            elif op == b"TJ" and strings:
+                show(strings[-1])
+            elif op == b"'" and strings:
+                ly -= leading
+                x, y = lx, ly
+                show(strings[-1])
+            elif op == b'"' and strings:
+                ly -= leading
+                x, y = lx, ly
+                show(strings[-1])
+            nums.clear()
+            strings.clear()
+    return words
+
+
+def extract_words(path: str) -> List[List[Tuple[float, float, str]]]:
+    """Per-page positioned text runs [(x, y, text), ...] for layout
+    analysis (the pdfplumber-words role in the reference's
+    custom_pdf_parser.py table/paragraph grouping)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if not data.startswith(b"%PDF"):
+        raise ValueError(f"{path} is not a PDF")
+    pdf = _PDF(data)
+    return [_stream_words(s) for s in pdf.page_content_streams()]
