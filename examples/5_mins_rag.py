@@ -1,0 +1,65 @@
+"""The 5-minute RAG example — hello world for the TPU framework.
+
+Parity with the reference's examples/5_mins_rag_no_gpu/main.py
+(Streamlit + FAISS + API-catalog endpoints, :40-140): ingest a few
+files, ask questions, stream answers. Streamlit isn't in the TPU image,
+so this is a terminal REPL; the moving parts are identical — splitter,
+in-memory vector store, embedder, streaming LLM.
+
+Zero-config demo (fake echo LLM + hash embedder, no weights, no
+network):
+
+    python examples/5_mins_rag.py README.md
+
+Against a real endpoint (the TPU engine server or any OpenAI-compatible
+/v1):
+
+    APP_LLM_MODELENGINE=openai APP_LLM_SERVERURL=http://localhost:8000/v1 \\
+    APP_EMBEDDINGS_MODELENGINE=openai \\
+    APP_EMBEDDINGS_SERVERURL=http://localhost:8000/v1 \\
+    python examples/5_mins_rag.py docs/*.md
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from generativeaiexamples_tpu.config.wizard import load_config  # noqa: E402
+from generativeaiexamples_tpu.pipelines.base import get_example_class  # noqa: E402
+from generativeaiexamples_tpu.pipelines.resources import Resources  # noqa: E402
+
+
+def main() -> None:
+    files = sys.argv[1:]
+    if not files:
+        print(__doc__)
+        raise SystemExit("usage: python examples/5_mins_rag.py <files...>")
+
+    # Default to the hermetic fakes unless the env selects an engine
+    # (the reference defaults to API-catalog endpoints, main.py:40-43).
+    os.environ.setdefault("APP_LLM_MODELENGINE", "echo")
+    os.environ.setdefault("APP_EMBEDDINGS_MODELENGINE", "hash")
+    cfg = load_config(None)
+    res = Resources(cfg)
+    rag = get_example_class("developer_rag")(res)
+
+    for path in files:
+        rag.ingest_docs(path, os.path.basename(path))
+        print(f"ingested {path}")
+
+    print("\nAsk about your documents (empty line to quit).")
+    while True:
+        try:
+            q = input("\n> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not q:
+            break
+        for chunk in rag.rag_chain(q, [], max_tokens=512):
+            print(chunk, end="", flush=True)
+        print()
+
+
+if __name__ == "__main__":
+    main()

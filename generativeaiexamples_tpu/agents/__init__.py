@@ -1,0 +1,1 @@
+"""Agentic pipelines (reference experimental/ agent workloads)."""

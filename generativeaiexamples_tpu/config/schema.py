@@ -98,6 +98,13 @@ class RetrieverConfig:
     nr_url: str = ""
     nr_pipeline: str = "ranked_hybrid"
     max_context_tokens: int = 1500  # LimitRetrievedNodesLength cap, utils.py:97
+    # Query augmentation before retrieval (oran-chatbot capabilities,
+    # Multimodal_Assistant.py:112-150): "" | rewrite | hyde | multi_query.
+    # Combinable comma-separated ("rewrite,hyde").
+    query_augmentation: str = ""
+    # Stream a fact-check verdict after the answer (guardrails/
+    # fact_check.py:29-37).
+    fact_check: bool = False
 
 
 @dataclass(frozen=True)

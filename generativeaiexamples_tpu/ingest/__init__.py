@@ -1,0 +1,18 @@
+"""Declarative streaming ingest: sources -> extract -> chunk -> embed -> store.
+
+TPU-native port of the reference's Morpheus vdb_upload pipeline
+(experimental/streaming_ingest_rag/.../vdb_upload/pipeline.py:32-102):
+the Morpheus C++ runtime becomes an asyncio pipeline (SURVEY.md §2.3
+judged no native runtime necessary at reference scale), the per-source
+declarative YAML schemas (vdb_upload/schemas/*.py) become plain config
+dicts, and the Triton embedding stage becomes the framework's batched
+embedder connector. Sources: filesystem (with watch), RSS/Atom feeds
+(with web-scraper content fetch), and an in-process queue that is the
+Kafka-consumer seam (kafka_source_pipe.py role) — hermetically testable.
+"""
+
+from generativeaiexamples_tpu.ingest.pipeline import (
+    FileSource, IngestPipeline, QueueSource, RSSSource, build_sources)
+
+__all__ = ["IngestPipeline", "FileSource", "RSSSource", "QueueSource",
+           "build_sources"]

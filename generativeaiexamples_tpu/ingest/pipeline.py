@@ -1,0 +1,318 @@
+"""Async ingest pipeline over declarative source configs.
+
+Stage graph (vdb_upload/pipeline.py:32-102 parity):
+
+    sources --> chunk (splitter) --> embed (batched) --> store sink
+       \\-> per-stage counters (MonitorStage role: docs/chunks/embeddings)
+
+Each source yields IngestItem(text, metadata). The embed stage batches
+across sources (the reference isolates embedding throughput the same
+way with its TritonInferenceStage batch knobs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import glob as globlib
+import html
+import html.parser
+import logging
+import os
+import re
+import time
+import xml.etree.ElementTree as ET
+from typing import AsyncIterator, Dict, List, Optional, Sequence
+
+_LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class IngestItem:
+    text: str
+    metadata: Dict = dataclasses.field(default_factory=dict)
+
+
+class _TextFromHTML(html.parser.HTMLParser):
+    """Web-scraper content extraction (web_scraper_module.py role)
+    without bs4: visible text, scripts/styles dropped."""
+
+    SKIP = {"script", "style", "noscript", "head"}
+
+    def __init__(self):
+        super().__init__()
+        self.parts: List[str] = []
+        self._skip_depth = 0
+
+    def handle_starttag(self, tag, attrs):
+        if tag in self.SKIP:
+            self._skip_depth += 1
+
+    def handle_endtag(self, tag):
+        if tag in self.SKIP and self._skip_depth:
+            self._skip_depth -= 1
+
+    def handle_data(self, data):
+        if not self._skip_depth and data.strip():
+            self.parts.append(data.strip())
+
+
+def html_to_text(markup: str) -> str:
+    p = _TextFromHTML()
+    try:
+        p.feed(markup)
+    except Exception:  # malformed markup: keep what parsed
+        pass
+    return "\n".join(p.parts)
+
+
+# ---------------------------------------------------------------------------
+# Sources (file_source_pipe.py / rss_source_pipe.py / kafka_source_pipe.py)
+# ---------------------------------------------------------------------------
+
+
+class FileSource:
+    """Glob-driven filesystem source with optional watch mode
+    (file_source_pipe_schema.py:27-38: filenames, watch,
+    watch_interval)."""
+
+    def __init__(self, filenames: Sequence[str], *, watch: bool = False,
+                 watch_interval: float = 1.0, source_name: str = "file"):
+        self.patterns = list(filenames)
+        self.watch = watch
+        self.watch_interval = watch_interval
+        self.source_name = source_name
+        self._seen: Dict[str, float] = {}  # path -> mtime
+        self.stop_event = asyncio.Event()
+
+    def _scan(self) -> List[str]:
+        fresh = []
+        for pat in self.patterns:
+            for path in sorted(globlib.glob(pat)):
+                try:
+                    mtime = os.path.getmtime(path)
+                except OSError:
+                    continue
+                if self._seen.get(path) != mtime:
+                    self._seen[path] = mtime
+                    fresh.append(path)
+        return fresh
+
+    async def items(self) -> AsyncIterator[IngestItem]:
+        from generativeaiexamples_tpu.rag.documents import load_document
+
+        while True:
+            for path in self._scan():
+                try:
+                    docs = await asyncio.to_thread(
+                        load_document, path, os.path.basename(path))
+                except Exception as e:
+                    _LOG.warning("file source failed on %s: %s", path, e)
+                    continue
+                for d in docs:
+                    yield IngestItem(d.text, {
+                        **d.metadata, "source": self.source_name,
+                        "filename": os.path.basename(path)})
+            if not self.watch or self.stop_event.is_set():
+                return
+            try:
+                await asyncio.wait_for(self.stop_event.wait(),
+                                       timeout=self.watch_interval)
+                return
+            except asyncio.TimeoutError:
+                continue
+
+
+class RSSSource:
+    """RSS/Atom feed source (rss_source_pipe.py role). Feeds come from
+    URLs or local files; with fetch_content each entry's link is
+    downloaded and text-extracted (web_scraper_module.py role),
+    otherwise the entry summary is used."""
+
+    def __init__(self, feed_input: Sequence[str], *,
+                 fetch_content: bool = False, source_name: str = "rss"):
+        self.feeds = list(feed_input)
+        self.fetch_content = fetch_content
+        self.source_name = source_name
+
+    @staticmethod
+    def _read(ref: str) -> str:
+        if re.match(r"https?://", ref):
+            import requests
+
+            r = requests.get(ref, timeout=30)
+            r.raise_for_status()
+            return r.text
+        with open(ref) as fh:
+            return fh.read()
+
+    @staticmethod
+    def _entries(xml_text: str) -> List[Dict[str, str]]:
+        """Both RSS (<item>) and Atom (<entry>), namespace-agnostic."""
+        root = ET.fromstring(xml_text)
+        out = []
+        for node in root.iter():
+            tag = node.tag.rsplit("}", 1)[-1]
+            if tag not in ("item", "entry"):
+                continue
+            entry: Dict[str, str] = {}
+            for child in node:
+                ctag = child.tag.rsplit("}", 1)[-1]
+                if ctag in ("title", "description", "summary", "content"):
+                    entry[ctag] = html.unescape(
+                        "".join(child.itertext()).strip())
+                elif ctag == "link":
+                    entry["link"] = child.get("href") or (child.text or "")
+            if entry:
+                out.append(entry)
+        return out
+
+    async def items(self) -> AsyncIterator[IngestItem]:
+        for ref in self.feeds:
+            try:
+                entries = self._entries(await asyncio.to_thread(
+                    self._read, ref))
+            except Exception as e:
+                _LOG.warning("rss source failed on %s: %s", ref, e)
+                continue
+            for e in entries:
+                body = e.get("description") or e.get("summary") \
+                    or e.get("content") or ""
+                link = e.get("link", "")
+                if self.fetch_content and link:
+                    try:
+                        body = html_to_text(await asyncio.to_thread(
+                            self._read, link))
+                    except Exception as ex:
+                        _LOG.warning("content fetch failed for %s: %s",
+                                     link, ex)
+                text = "\n".join(p for p in (e.get("title", ""), body) if p)
+                if text:
+                    yield IngestItem(text, {"source": self.source_name,
+                                            "link": link,
+                                            "title": e.get("title", "")})
+
+
+class QueueSource:
+    """In-process message-bus source — the Kafka-consumer seam
+    (kafka_source_pipe.py role; a real deployment points a thin
+    consumer at `push`). `close()` ends the stream."""
+
+    _DONE = object()
+
+    def __init__(self, source_name: str = "queue"):
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.source_name = source_name
+
+    def push(self, text: str, metadata: Optional[Dict] = None) -> None:
+        self.queue.put_nowait(IngestItem(text, metadata or {}))
+
+    def close(self) -> None:
+        self.queue.put_nowait(self._DONE)
+
+    async def items(self) -> AsyncIterator[IngestItem]:
+        while True:
+            item = await self.queue.get()
+            if item is self._DONE:
+                return
+            item.metadata.setdefault("source", self.source_name)
+            yield item
+
+
+def build_sources(source_config: Sequence[Dict]) -> List:
+    """Declarative configs -> source objects (the reference's per-source
+    pydantic schemas, vdb_upload/schemas/*): [{"type": "filesystem",
+    "filenames": [...], "watch": false}, {"type": "rss", ...},
+    {"type": "queue"}]."""
+    out = []
+    for cfg in source_config:
+        kind = cfg.get("type")
+        if kind == "filesystem":
+            out.append(FileSource(
+                cfg["filenames"], watch=bool(cfg.get("watch", False)),
+                watch_interval=float(cfg.get("watch_interval", 1.0)),
+                source_name=cfg.get("name", "file")))
+        elif kind == "rss":
+            out.append(RSSSource(
+                cfg["feed_input"],
+                fetch_content=bool(cfg.get("fetch_content", False)),
+                source_name=cfg.get("name", "rss")))
+        elif kind == "queue":
+            out.append(QueueSource(source_name=cfg.get("name", "queue")))
+        else:
+            raise ValueError(f"unknown source type {kind!r}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+
+
+class IngestPipeline:
+    """sources -> chunk -> batched embed -> store (pipeline.py:32-102).
+
+    `stats` carries the MonitorStage counters: per-stage totals and the
+    embed-stage rate.
+    """
+
+    def __init__(self, sources: Sequence, splitter, embedder, store, *,
+                 embed_batch: int = 64):
+        self.sources = list(sources)
+        self.splitter = splitter
+        self.embedder = embedder
+        self.store = store
+        self.embed_batch = embed_batch
+        self.stats = {"documents": 0, "chunks": 0, "embeddings": 0,
+                      "elapsed_s": 0.0}
+
+    async def _produce(self, source, chunk_q: asyncio.Queue) -> None:
+        async for item in source.items():
+            self.stats["documents"] += 1
+            for c in self.splitter.split(item.text):
+                await chunk_q.put((c, dict(item.metadata)))
+                self.stats["chunks"] += 1
+
+    async def _embed_and_store(self, chunk_q: asyncio.Queue,
+                               done: asyncio.Event) -> None:
+        buf: List = []
+
+        async def flush():
+            if not buf:
+                return
+            texts = [t for t, _ in buf]
+            metas = [m for _, m in buf]
+            embs = await asyncio.to_thread(
+                self.embedder.embed_documents, texts)
+            await asyncio.to_thread(self.store.add, texts, embs, metas)
+            self.stats["embeddings"] += len(buf)
+            buf.clear()
+
+        while True:
+            try:
+                buf.append(await asyncio.wait_for(chunk_q.get(), timeout=0.1))
+                if len(buf) >= self.embed_batch:
+                    await flush()
+            except asyncio.TimeoutError:
+                await flush()  # drain partial batches while idle
+                if done.is_set() and chunk_q.empty():
+                    return
+
+    async def run_async(self) -> Dict:
+        t0 = time.perf_counter()
+        chunk_q: asyncio.Queue = asyncio.Queue(maxsize=4096)
+        done = asyncio.Event()
+        sink = asyncio.create_task(self._embed_and_store(chunk_q, done))
+        try:
+            await asyncio.gather(*(self._produce(s, chunk_q)
+                                   for s in self.sources))
+        finally:
+            done.set()
+            await sink
+        self.stats["elapsed_s"] = round(time.perf_counter() - t0, 3)
+        rate = self.stats["embeddings"] / max(self.stats["elapsed_s"], 1e-6)
+        _LOG.info("ingest done: %s (%.0f embeddings/s)", self.stats, rate)
+        return dict(self.stats)
+
+    def run(self) -> Dict:
+        return asyncio.run(self.run_async())

@@ -65,6 +65,59 @@ class BaseExample(abc.ABC):
     def ingest_docs(self, filepath: str, filename: str) -> None:
         """Ingest one uploaded document (base.py:30-32)."""
 
+    # -- shared answer-quality helpers (oran-chatbot capabilities) ------
+    # Config-driven, honored by every retrieval-grounded pipeline (the
+    # agent pipelines — query_decomposition, structured_data — have no
+    # single retrieval step for these to hook).
+
+    def retrieve_with_augmentation(self, query: str, chat_history):
+        """(possibly rewritten) query + hits via the CONFIGURED
+        retrieval path (ranked_hybrid included), honoring
+        retriever.query_augmentation: rewrite | hyde | multi_query,
+        comma-combinable. Unknown modes warn and are ignored."""
+        import logging
+
+        from generativeaiexamples_tpu.rag import augmentation as aug
+
+        rcfg = self.res.config.retriever
+        modes = {m.strip() for m in rcfg.query_augmentation.split(",")
+                 if m.strip()}
+        unknown = modes - {"rewrite", "hyde", "multi_query"}
+        if unknown:
+            logging.getLogger(__name__).warning(
+                "unknown query_augmentation modes ignored: %s "
+                "(valid: rewrite, hyde, multi_query)", sorted(unknown))
+            modes -= unknown
+        retrieve = self.res.retriever.retrieve_default
+        if not modes:
+            return query, retrieve(query)
+        q = query
+        if "rewrite" in modes:
+            q = aug.query_rewriting(self.res.llm, q, chat_history)
+        variants = [q]
+        if "hyde" in modes:
+            variants.append(aug.augment_query_generated(self.res.llm, q))
+        if "multi_query" in modes:
+            variants.extend(aug.augment_multiple_query(self.res.llm, q))
+        if len(variants) == 1:
+            return q, retrieve(q)
+        return q, aug.retrieve_fused(retrieve, variants, top_k=rcfg.top_k)
+
+    def answer_with_fact_check(self, query: str, context: str, token_iter
+                               ) -> Generator[str, None, None]:
+        """Stream `token_iter`; with retriever.fact_check on, buffer it
+        and append the guardrail verdict (fact_check.py:29-37 flow)."""
+        if not self.res.config.retriever.fact_check:
+            yield from token_iter
+            return
+        from generativeaiexamples_tpu.rag import augmentation as aug
+
+        answer = "".join(token_iter)
+        yield answer
+        yield "\n\n[fact-check] "
+        yield from aug.fact_check(self.res.llm, context, query, answer,
+                                  max_tokens=512)
+
     # optional interface (server probes with hasattr)
     def document_search(self, content: str, num_docs: int) -> List[Dict]:
         raise NotImplementedError

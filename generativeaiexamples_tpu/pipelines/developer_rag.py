@@ -45,7 +45,7 @@ class QAChatbot(BaseExample):
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:
-        results = self.res.retriever.retrieve_default(query)
+        query, results = self.retrieve_with_augmentation(query, chat_history)
         if not results:
             # Reference behavior: short-circuit when retrieval is empty
             # (developer_rag/chains.py:157-163).
@@ -57,7 +57,8 @@ class QAChatbot(BaseExample):
         system = self.res.config.prompts.rag_template.format(context=context)
         messages = [{"role": "system", "content": system},
                     {"role": "user", "content": query}]
-        yield from self.res.llm.stream_chat(messages, **llm_settings)
+        yield from self.answer_with_fact_check(
+            query, context, self.res.llm.stream_chat(messages, **llm_settings))
 
     def document_search(self, content: str, num_docs: int) -> List[Dict]:
         results = self.res.retriever.retrieve(content, top_k=num_docs,

@@ -104,7 +104,7 @@ class KnowledgeGraphRAG(BaseExample):
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:
-        hits = self.res.retriever.retrieve_default(query)
+        query, hits = self.retrieve_with_augmentation(query, chat_history)
         hits = self.res.retriever.limit_tokens(hits) if hits else []
         parts = []
         if hits:
@@ -119,7 +119,9 @@ class KnowledgeGraphRAG(BaseExample):
         messages = [{"role": "system", "content": system},
                     {"role": "user",
                      "content": f"Context: {context}\n\nUser query: {query}"}]
-        yield from self.res.llm.stream_chat(messages, **llm_settings)
+        yield from self.answer_with_fact_check(
+            query, context,
+            self.res.llm.stream_chat(messages, **llm_settings))
 
     def llm_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:

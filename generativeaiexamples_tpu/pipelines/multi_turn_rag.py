@@ -44,7 +44,7 @@ class MultiTurnChatbot(QAChatbot):
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:
-        results = self.res.retriever.retrieve_default(query)
+        query, results = self.retrieve_with_augmentation(query, chat_history)
         results = self.res.retriever.limit_tokens(results)
         context = "\n\n".join(r.text for r in results)
         history = self._history_context(query)

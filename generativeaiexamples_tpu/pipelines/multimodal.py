@@ -89,32 +89,40 @@ class MultimodalRAG(QAChatbot):
 
     def _ingest_document(self, filepath: str, filename: str,
                          chunks: List[str], metas: List[Dict]) -> None:
-        from generativeaiexamples_tpu.rag.documents import load_document
-
         splitter = RecursiveCharacterSplitter(1000, 100)  # multimodal split
-        docs = load_document(filepath, filename)
-        full_text = "\n".join(d.text for d in docs)
+        parsed = None
+        if filepath.lower().endswith(".pdf"):
+            # ONE parse serves text + layout tables + images (the
+            # per-view functions each re-scan the whole file).
+            from generativeaiexamples_tpu.utils.pdf import ParsedPDF
+
+            parsed = ParsedPDF(filepath)
+            full_text = parsed.text()
+        else:
+            from generativeaiexamples_tpu.rag.documents import load_document
+
+            docs = load_document(filepath, filename)
+            full_text = "\n".join(d.text for d in docs)
         for c in splitter.split(full_text):
             chunks.append(c)
             metas.append({"filename": filename, "content_type": "text"})
-        for t in self._document_tables(filepath, full_text):
+        for t in self._document_tables(parsed, full_text):
             chunks.append(t)
             metas.append({"filename": filename, "content_type": "table"})
-        if filepath.lower().endswith(".pdf"):
-            self._ingest_pdf_images(filepath, filename, chunks, metas)
+        if parsed is not None:
+            self._ingest_pdf_images(parsed, filename, chunks, metas)
 
-    def _document_tables(self, filepath: str, full_text: str) -> List[str]:
+    def _document_tables(self, parsed, full_text: str) -> List[str]:
         """Layout-analysis tables for PDFs (positioned runs -> grids);
         whitespace heuristic for everything else."""
-        if filepath.lower().endswith(".pdf"):
-            from generativeaiexamples_tpu.utils import layout, pdf
+        if parsed is not None:
+            from generativeaiexamples_tpu.utils import layout
 
             try:
-                return layout.page_tables_as_text(
-                    pdf.extract_words(filepath))
+                return layout.page_tables_as_text(parsed.words())
             except Exception:
                 _LOG.exception("layout analysis failed for %s; falling "
-                               "back to text heuristic", filepath)
+                               "back to text heuristic", parsed.path)
         return find_tables(full_text)
 
     def _ingest_pptx(self, filepath: str, filename: str,
@@ -158,12 +166,10 @@ class MultimodalRAG(QAChatbot):
                          "configured; skipping image enrichment",
                          filename, skipped_images)
 
-    def _ingest_pdf_images(self, filepath: str, filename: str,
+    def _ingest_pdf_images(self, parsed, filename: str,
                            chunks: List[str], metas: List[Dict]) -> None:
-        from generativeaiexamples_tpu.utils.pdf import extract_images
-
         vlm = self._vlm()
-        images = extract_images(filepath)
+        images = parsed.images()
         if images and vlm is None:
             _LOG.warning("%s has %d images but no VLM endpoint configured "
                          "(vlm.server_url); skipping image enrichment",
@@ -204,17 +210,20 @@ class MultimodalRAG(QAChatbot):
 
         if not content_type:
             return fetch(num_docs)
-        out = fetch(num_docs * 4)
-        if len(out) < num_docs:
+        first_k = num_docs * 4
+        out = fetch(first_k)
+        if len(out) < num_docs and first_k < len(self.res.store):
             # The wanted type may rank below the over-fetch horizon
             # (e.g. 5 tables among hundreds of text chunks): widen to
-            # the whole store rather than report a false empty.
+            # the whole store rather than report a false empty. Skipped
+            # when the first fetch already spanned the store — there is
+            # nothing more to find.
             out = fetch(len(self.res.store))
         return out
 
     def rag_chain(self, query: str, chat_history, **llm_settings
                   ) -> Generator[str, None, None]:
-        results = self.res.retriever.retrieve_default(query)
+        query, results = self.retrieve_with_augmentation(query, chat_history)
         if not results:
             yield ("No response generated from LLM, make sure your query is "
                    "relevant to the ingested document.")
@@ -224,8 +233,10 @@ class MultimodalRAG(QAChatbot):
         for r in results:
             tag = r.metadata.get("content_type", "text")
             parts.append(f"[{tag}] {r.text}")
-        system = self.res.config.prompts.rag_template.format(
-            context="\n\n".join(parts))
+        context = "\n\n".join(parts)
+        system = self.res.config.prompts.rag_template.format(context=context)
         messages = [{"role": "system", "content": system},
                     {"role": "user", "content": query}]
-        yield from self.res.llm.stream_chat(messages, **llm_settings)
+        yield from self.answer_with_fact_check(
+            query, context,
+            self.res.llm.stream_chat(messages, **llm_settings))

@@ -1,0 +1,136 @@
+"""LoRA fine-tuning for the llama models.
+
+The reference ships LoRA only as NeMo notebooks
+(models/Gemma/gemma-lora.ipynb etc., SURVEY.md §2.1); here it is a
+first-class sharded recipe on the same mesh machinery as full SFT
+(training/trainer.py): low-rank adapters on selected projection
+weights, gradients flow ONLY through the adapters (the frozen base
+never enters the optimizer state — the whole point of LoRA's memory
+budget), and `merge` folds trained adapters back into base weights so
+the serving engine needs no LoRA-aware code path.
+
+Sharding: A [L, in, r] shards like the weight's input axis, B
+[L, r, out] like its output axis, so the low-rank matmuls ride the same
+tensor-parallel layout as the base weight with no extra collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.parallel.mesh import LLM_RULES, logical_to_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Attention q/v is the classic LoRA target set; any of the seven
+    # projection names in the llama layer stack are accepted.
+    targets: Tuple[str, ...] = ("wq", "wv")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(lcfg: llama.LlamaConfig, lora_cfg: LoraConfig,
+              key: jax.Array) -> Dict:
+    """Adapters for the stacked layer weights: a ~ N(0, 1/in), b = 0 —
+    the standard init that makes the adapted model exactly equal the
+    base model at step 0."""
+    dims = {
+        "wq": (lcfg.dim, lcfg.n_heads * lcfg.head_dim),
+        "wk": (lcfg.dim, lcfg.n_kv_heads * lcfg.head_dim),
+        "wv": (lcfg.dim, lcfg.n_kv_heads * lcfg.head_dim),
+        "wo": (lcfg.n_heads * lcfg.head_dim, lcfg.dim),
+        "w_gate": (lcfg.dim, lcfg.mlp_dim),
+        "w_up": (lcfg.dim, lcfg.mlp_dim),
+        "w_down": (lcfg.mlp_dim, lcfg.dim),
+    }
+    unknown = set(lora_cfg.targets) - set(dims)
+    if unknown:
+        raise ValueError(f"unknown LoRA targets {sorted(unknown)}")
+    out: Dict = {}
+    L, r = lcfg.n_layers, lora_cfg.rank
+    for i, name in enumerate(lora_cfg.targets):
+        d_in, d_out = dims[name]
+        k = jax.random.fold_in(key, i)
+        out[name] = {
+            "a": (jax.random.normal(k, (L, d_in, r)) * d_in ** -0.5
+                  ).astype(jnp.float32),
+            "b": jnp.zeros((L, r, d_out), jnp.float32),
+        }
+    return out
+
+
+def lora_param_specs(lora_params: Dict, rules=None) -> Dict:
+    """PartitionSpecs parallel to init_lora output. The rank axis is
+    tiny and stays replicated; in/out axes follow the base weight."""
+    rules = rules or LLM_RULES
+    out_axis = {"wq": "heads", "wk": "kv_heads", "wv": "kv_heads",
+                "wo": "embed_fsdp", "w_gate": "mlp", "w_up": "mlp",
+                "w_down": "embed_fsdp"}
+    in_axis = {"wq": "embed_fsdp", "wk": "embed_fsdp", "wv": "embed_fsdp",
+               "wo": "heads", "w_gate": "embed_fsdp", "w_up": "embed_fsdp",
+               "w_down": "mlp"}
+    specs: Dict = {}
+    for name in lora_params:
+        specs[name] = {
+            "a": logical_to_spec(("layers", in_axis[name], None), rules),
+            "b": logical_to_spec(("layers", None, out_axis[name]), rules),
+        }
+    return specs
+
+
+def merge(params: Dict, lora_params: Dict, lora_cfg: LoraConfig) -> Dict:
+    """Fold adapters into base weights: w + scale * (a @ b), batched
+    over the layer axis. Returns a NEW param tree the serving engine
+    consumes unchanged (and can int8-quantize afterwards)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name, ab in lora_params.items():
+        w = layers[name]
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) \
+            * lora_cfg.scale
+        layers[name] = (w + delta.astype(w.dtype)).astype(w.dtype)
+    out["layers"] = layers
+    return out
+
+
+def loss_with_lora(lora_params: Dict, base_params: Dict,
+                   lcfg: llama.LlamaConfig, lora_cfg: LoraConfig,
+                   tokens, targets, mask):
+    """SFT loss on the merged model; only `lora_params` is the
+    differentiated argument, so the base stays frozen (no gradients, no
+    optimizer state for it)."""
+    merged = merge(jax.lax.stop_gradient(base_params), lora_params,
+                   lora_cfg)
+    from generativeaiexamples_tpu.training.trainer import loss_fn
+
+    return loss_fn(merged, lcfg, tokens, targets, mask)
+
+
+def make_lora_train_step(lcfg: llama.LlamaConfig, lora_cfg: LoraConfig,
+                         optimizer: optax.GradientTransformation):
+    """jit-able (lora_params, opt_state, base_params, batch) ->
+    (lora_params, opt_state, metrics)."""
+
+    def step(lora_params, opt_state, base_params, batch):
+        loss, grads = jax.value_and_grad(loss_with_lora)(
+            lora_params, base_params, lcfg, lora_cfg,
+            batch["tokens"], batch["targets"], batch["mask"])
+        updates, opt_state = optimizer.update(grads, opt_state, lora_params)
+        lora_params = optax.apply_updates(lora_params, updates)
+        return lora_params, opt_state, {
+            "loss": loss,
+            "lora_grad_norm": optax.global_norm(grads),
+        }
+
+    return step

@@ -193,7 +193,10 @@ def extract_images(path: str) -> List[Tuple[str, bytes]]:
     imaging libs in the environment to re-encode raw pixel data)."""
     with open(path, "rb") as fh:
         data = fh.read()
-    pdf = _PDF(data)
+    return _images_from(_PDF(data))
+
+
+def _images_from(pdf: "_PDF") -> List[Tuple[str, bytes]]:
     out: List[Tuple[str, bytes]] = []
     for body in pdf.objects.values():
         if b"/Subtype" not in body or b"/Image" not in body:
@@ -325,3 +328,36 @@ def extract_words(path: str) -> List[List[Tuple[float, float, str]]]:
         raise ValueError(f"{path} is not a PDF")
     pdf = _PDF(data)
     return [_stream_words(s) for s in pdf.page_content_streams()]
+
+
+class ParsedPDF:
+    """One parse, all views: the multimodal pipeline needs text, words
+    AND images from the same file; the function-per-view API re-scanned
+    and re-decompressed every stream per call (3x ingest cost)."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if not data.startswith(b"%PDF"):
+            raise ValueError(f"{path} is not a PDF")
+        self.path = path
+        self.encrypted = (b"/Encrypt" in data[:4096]
+                          or b"/Encrypt" in data[-4096:])
+        self._pdf = None if self.encrypted else _PDF(data)
+        self._streams = (self._pdf.page_content_streams()
+                         if self._pdf else [])
+
+    def text(self) -> str:
+        if self.encrypted:
+            _LOG.warning("%s is encrypted; cannot extract text", self.path)
+            return ""
+        pages = [_stream_text(s) for s in self._streams]
+        return "\f".join(p for p in pages if p.strip())
+
+    def words(self) -> List[List[Tuple[float, float, str]]]:
+        return [_stream_words(s) for s in self._streams]
+
+    def images(self) -> List[Tuple[str, bytes]]:
+        if self._pdf is None:
+            return []
+        return _images_from(self._pdf)

@@ -84,8 +84,14 @@ def _rels(zf: zipfile.ZipFile, part_path: str) -> Dict[str, str]:
         return out
     for rel in rels.findall(f"{{{_REL_NS}}}Relationship"):
         target = rel.get("Target", "")
-        out[rel.get("Id", "")] = os.path.normpath(
-            os.path.join(os.path.dirname(part_path), target))
+        if target.startswith("/"):
+            # OPC package-absolute target: resolve from the zip root
+            # (zip members carry no leading slash).
+            resolved = target.lstrip("/")
+        else:
+            resolved = os.path.normpath(
+                os.path.join(os.path.dirname(part_path), target))
+        out[rel.get("Id", "")] = resolved
     return out
 
 

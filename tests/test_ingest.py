@@ -1,0 +1,165 @@
+"""Declarative streaming ingest: file/rss/queue sources through the
+chunk->embed->store pipeline, watch mode, declarative construction
+(reference vdb_upload pipeline, SURVEY.md §2.2 streaming_ingest_rag)."""
+
+import asyncio
+import threading
+import time
+
+from generativeaiexamples_tpu.connectors.fakes import HashEmbedder
+from generativeaiexamples_tpu.ingest import (
+    FileSource, IngestPipeline, QueueSource, RSSSource, build_sources)
+from generativeaiexamples_tpu.ingest.pipeline import html_to_text
+from generativeaiexamples_tpu.rag.splitter import RecursiveCharacterSplitter
+from generativeaiexamples_tpu.rag.vectorstore import MemoryVectorStore
+
+
+def make_pipeline(sources, batch=8):
+    store = MemoryVectorStore(32)
+    pipe = IngestPipeline(sources, RecursiveCharacterSplitter(120, 0),
+                          HashEmbedder(32), store, embed_batch=batch)
+    return pipe, store
+
+
+RSS_XML = """<?xml version="1.0"?>
+<rss version="2.0"><channel>
+  <item><title>TPU v5e launched</title>
+    <description>The chip ships with &lt;b&gt;16 GB&lt;/b&gt; HBM.</description>
+    <link>http://example.com/a</link></item>
+  <item><title>Ring attention paper</title>
+    <description>Sequence parallelism over ICI links.</description></item>
+</channel></rss>"""
+
+ATOM_XML = """<?xml version="1.0"?>
+<feed xmlns="http://www.w3.org/2005/Atom">
+  <entry><title>Pallas guide</title>
+    <summary>Kernels stream pages into VMEM.</summary>
+    <link href="http://example.com/b"/></entry>
+</feed>"""
+
+
+class TestSources:
+    def test_file_source_reads_and_dedupes(self, tmp_path):
+        (tmp_path / "a.txt").write_text("alpha doc content")
+        (tmp_path / "b.txt").write_text("beta doc content")
+        src = FileSource([str(tmp_path / "*.txt")])
+
+        async def run():
+            return [i async for i in src.items()]
+
+        items = asyncio.run(run())
+        assert sorted(i.metadata["filename"] for i in items) \
+            == ["a.txt", "b.txt"]
+        # second pass: nothing new
+        assert asyncio.run(run()) == []
+
+    def test_file_source_watch_picks_up_new_file(self, tmp_path):
+        (tmp_path / "a.txt").write_text("first file")
+        src = FileSource([str(tmp_path / "*.txt")], watch=True,
+                         watch_interval=0.05)
+        got = []
+
+        async def run():
+            async for item in src.items():
+                got.append(item.metadata["filename"])
+                if len(got) >= 2:
+                    src.stop_event.set()
+
+        def add_later():
+            time.sleep(0.2)
+            (tmp_path / "late.txt").write_text("late arrival")
+
+        t = threading.Thread(target=add_later)
+        t.start()
+        asyncio.run(asyncio.wait_for(run(), timeout=5))
+        t.join()
+        assert set(got) == {"a.txt", "late.txt"}
+
+    def test_rss_and_atom_parse(self, tmp_path):
+        rss = tmp_path / "feed.xml"
+        rss.write_text(RSS_XML)
+        atom = tmp_path / "feed.atom"
+        atom.write_text(ATOM_XML)
+        src = RSSSource([str(rss), str(atom)])
+
+        async def run():
+            return [i async for i in src.items()]
+
+        items = asyncio.run(run())
+        assert len(items) == 3
+        assert "16 GB" in items[0].text  # entities unescaped
+        assert items[0].metadata["link"] == "http://example.com/a"
+        assert items[2].metadata["title"] == "Pallas guide"
+
+    def test_queue_source_is_kafka_seam(self):
+        src = QueueSource(source_name="kafka")
+        src.push("message one", {"topic": "t"})
+        src.push("message two")
+        src.close()
+
+        async def run():
+            return [i async for i in src.items()]
+
+        items = asyncio.run(run())
+        assert [i.text for i in items] == ["message one", "message two"]
+        assert items[0].metadata == {"topic": "t", "source": "kafka"}
+
+    def test_html_to_text_strips_script(self):
+        out = html_to_text("<html><head><script>x()</script></head>"
+                           "<body><h1>Title</h1><p>Body text</p></body>")
+        assert "Title" in out and "Body text" in out and "x()" not in out
+
+
+class TestDeclarativeBuild:
+    def test_build_sources_from_config(self, tmp_path):
+        (tmp_path / "x.txt").write_text("doc")
+        srcs = build_sources([
+            {"type": "filesystem", "filenames": [str(tmp_path / "*.txt")]},
+            {"type": "rss", "feed_input": [], "name": "news"},
+            {"type": "queue", "name": "bus"},
+        ])
+        assert isinstance(srcs[0], FileSource)
+        assert isinstance(srcs[1], RSSSource)
+        assert srcs[1].source_name == "news"
+        assert isinstance(srcs[2], QueueSource)
+
+    def test_unknown_source_type_rejected(self):
+        try:
+            build_sources([{"type": "carrier-pigeon"}])
+        except ValueError as e:
+            assert "carrier-pigeon" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
+
+class TestPipeline:
+    def test_multi_source_end_to_end(self, tmp_path):
+        (tmp_path / "doc.txt").write_text(
+            "filesystem document about tpu serving throughput and paging")
+        rss = tmp_path / "feed.xml"
+        rss.write_text(RSS_XML)
+        q = QueueSource()
+        q.push("a streamed kafka-style message about ring attention")
+        q.close()
+        pipe, store = make_pipeline([
+            FileSource([str(tmp_path / "*.txt")]),
+            RSSSource([str(rss)]),
+            q,
+        ], batch=4)
+        stats = pipe.run()
+        assert stats["documents"] == 4  # 1 file + 2 rss + 1 queue
+        assert stats["chunks"] == stats["embeddings"] == len(store)
+        # source tags survive to the store (vdb_resource_tagging role)
+        tags = {d["metadata"]["source"] for d in store.snapshot_docs()}
+        assert tags == {"file", "rss", "queue"}
+        # and the content is retrievable
+        emb = HashEmbedder(32)
+        hits = store.search(emb.embed_query("ring attention"), top_k=2)
+        assert any("ring attention" in h.text for h in hits)
+
+    def test_partial_batches_flush(self, tmp_path):
+        (tmp_path / "one.txt").write_text("tiny")
+        pipe, store = make_pipeline(
+            [FileSource([str(tmp_path / "*.txt")])], batch=512)
+        stats = pipe.run()
+        assert stats["embeddings"] == len(store) == 1
