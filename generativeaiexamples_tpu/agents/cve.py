@@ -111,6 +111,11 @@ class CVEAgent:
     def _tool(self, action: str, arg: str) -> str:
         if action == "check_sbom":
             return self.sbom.lookup(arg)
+        if action not in ("search_code", "search_docs"):
+            # Feeding doc snippets under a bogus tool name would mislead
+            # the agent for the rest of the loop.
+            return (f"unknown tool {action!r}; valid tools: search_code, "
+                    "search_docs, check_sbom")
         retriever = (self.code_retriever if action == "search_code"
                      else self.docs_retriever)
         if retriever is None:

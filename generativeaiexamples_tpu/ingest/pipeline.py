@@ -203,14 +203,27 @@ class QueueSource:
     def __init__(self, source_name: str = "queue"):
         self.queue: asyncio.Queue = asyncio.Queue()
         self.source_name = source_name
+        self._loop = None  # captured when the pipeline starts consuming
+
+    def _put(self, item) -> None:
+        # asyncio.Queue is NOT thread-safe; a consumer thread (the
+        # advertised Kafka seam) must hand off through the loop.
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            loop.call_soon_threadsafe(self.queue.put_nowait, item)
+        else:
+            self.queue.put_nowait(item)  # pre-start, same-thread
 
     def push(self, text: str, metadata: Optional[Dict] = None) -> None:
-        self.queue.put_nowait(IngestItem(text, metadata or {}))
+        self._put(IngestItem(text, metadata or {}))
 
     def close(self) -> None:
-        self.queue.put_nowait(self._DONE)
+        self._put(self._DONE)
 
     async def items(self) -> AsyncIterator[IngestItem]:
+        import asyncio as _asyncio
+
+        self._loop = _asyncio.get_running_loop()
         while True:
             item = await self.queue.get()
             if item is self._DONE:

@@ -1,0 +1,13 @@
+"""Native runtime components (C, ctypes-bound).
+
+The compute path is JAX/XLA/Pallas; this package holds the pieces that
+belong in native code AROUND it — currently the SDR ingest ring buffer
++ GIL-free UDP drain loop (see sdr_ring.c for why). Compiled on demand
+with the in-image toolchain; everything here has a pure-Python fallback
+so the framework never hard-depends on a compiler at runtime.
+"""
+
+from generativeaiexamples_tpu.native.ring import (
+    IQRing, PyRing, native_available)
+
+__all__ = ["IQRing", "PyRing", "native_available"]

@@ -1,0 +1,200 @@
+"""ctypes binding for the C SPSC ring (sdr_ring.c) + Python fallback.
+
+Build happens lazily on first use: `cc -O2 -shared -fPIC` against the
+checked-in C source, cached next to it (or in a temp dir when the
+package is read-only). ctypes releases the GIL for every foreign call,
+so `recv_udp` drains the socket full-speed while JAX dispatch owns the
+Python side — the property the reference gets from Holoscan's C++
+network operator (operators.py:77-140).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_LOG = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "sdr_ring.c")
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+_BUILD_LOCK = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    # Preferred: next to the source (reused across processes via mtime).
+    so = os.path.join(os.path.dirname(_SRC), "_sdr_ring.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+        return so
+    try:
+        subprocess.run(["cc", "-O2", "-shared", "-fPIC", "-o", so, _SRC],
+                       check=True, capture_output=True, timeout=120)
+        return so
+    except (subprocess.SubprocessError, OSError, PermissionError) as e:
+        _LOG.debug("native build in package dir failed: %s", e)
+    # Read-only package dir: build into a FRESH private temp dir. Never
+    # load a pre-existing .so from the shared temp dir — a predictable
+    # world-writable path would let another local user plant a library
+    # that ctypes would happily execute.
+    try:
+        out_dir = tempfile.mkdtemp(prefix="gaie_tpu_native_")
+        so = os.path.join(out_dir, "_sdr_ring.so")
+        subprocess.run(["cc", "-O2", "-shared", "-fPIC", "-o", so, _SRC],
+                       check=True, capture_output=True, timeout=120)
+        return so
+    except (subprocess.SubprocessError, OSError, PermissionError) as e:
+        _LOG.debug("native build in temp dir failed: %s", e)
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    with _BUILD_LOCK:
+        if _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        so = _build()
+        if so is None:
+            _LOG.warning("C toolchain unavailable; SDR ring falls back to "
+                         "pure Python (packet loss possible under load)")
+            return None
+        lib = ctypes.CDLL(so)
+        lib.ring_create.restype = ctypes.c_void_p
+        lib.ring_create.argtypes = [ctypes.c_size_t]
+        lib.ring_destroy.argtypes = [ctypes.c_void_p]
+        lib.ring_capacity.restype = ctypes.c_size_t
+        lib.ring_capacity.argtypes = [ctypes.c_void_p]
+        lib.ring_size.restype = ctypes.c_size_t
+        lib.ring_size.argtypes = [ctypes.c_void_p]
+        lib.ring_dropped.restype = ctypes.c_uint64
+        lib.ring_dropped.argtypes = [ctypes.c_void_p]
+        lib.ring_received.restype = ctypes.c_uint64
+        lib.ring_received.argtypes = [ctypes.c_void_p]
+        lib.ring_push.restype = ctypes.c_size_t
+        lib.ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_size_t]
+        lib.ring_pop.restype = ctypes.c_size_t
+        lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_size_t]
+        lib.ring_recv_udp.restype = ctypes.c_long
+        lib.ring_recv_udp.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_long, ctypes.c_int]
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class PyRing:
+    """Pure-Python fallback with the same surface (and the same
+    whole-datagram drop semantics)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._buf = bytearray()
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.received = 0
+
+    def push(self, data: bytes) -> int:
+        with self._lock:
+            if len(self._buf) + len(data) > self.capacity:
+                self.dropped += len(data)
+                return 0
+            self._buf.extend(data)
+            self.received += len(data)
+            return len(data)
+
+    def pop(self, n: int) -> bytes:
+        with self._lock:
+            out = bytes(self._buf[:n])
+            del self._buf[:n]
+            return out
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def recv_udp(self, sock, max_bytes: int, idle_timeout_ms: int = 1000
+                 ) -> int:
+        import select
+
+        got = 0
+        while got < max_bytes:
+            r, _, _ = select.select([sock], [], [], idle_timeout_ms / 1e3)
+            if not r:
+                break
+            pkt = sock.recv(65536)
+            if not pkt:
+                break
+            got += self.push(pkt)
+        return got
+
+    def close(self) -> None:
+        pass
+
+
+class IQRing:
+    """C-backed SPSC ring; constructor falls back to PyRing semantics by
+    raising ImportError so callers can pick (`make_ring` below)."""
+
+    def __init__(self, capacity: int):
+        lib = _load()
+        if lib is None:
+            raise ImportError("native ring unavailable")
+        self._lib = lib
+        self._ptr = lib.ring_create(capacity)
+        if not self._ptr:
+            raise MemoryError("ring_create failed")
+        self.capacity = capacity
+
+    def push(self, data: bytes) -> int:
+        return self._lib.ring_push(self._ptr, data, len(data))
+
+    def pop(self, n: int) -> bytes:
+        out = ctypes.create_string_buffer(n)
+        got = self._lib.ring_pop(self._ptr, out, n)
+        return out.raw[:got]
+
+    def __len__(self) -> int:
+        return self._lib.ring_size(self._ptr)
+
+    @property
+    def dropped(self) -> int:
+        return self._lib.ring_dropped(self._ptr)
+
+    @property
+    def received(self) -> int:
+        return self._lib.ring_received(self._ptr)
+
+    def recv_udp(self, sock, max_bytes: int, idle_timeout_ms: int = 1000
+                 ) -> int:
+        """Drain `sock` into the ring OUTSIDE the GIL (the whole point).
+        Call from a dedicated thread; pop from the consumer thread."""
+        return self._lib.ring_recv_udp(self._ptr, sock.fileno(),
+                                       max_bytes, idle_timeout_ms)
+
+    def close(self) -> None:
+        if getattr(self, "_ptr", None):
+            self._lib.ring_destroy(self._ptr)
+            self._ptr = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_ring(capacity: int = 8 << 20):
+    """Best available ring implementation for this host."""
+    try:
+        return IQRing(capacity)
+    except ImportError:
+        return PyRing(capacity)

@@ -28,4 +28,6 @@ class APICatalogChat(QAChatbot):
                 else query)
         messages = ([{"role": "system", "content": system}]
                     + list(chat_history) + [{"role": "user", "content": user}])
-        yield from self.res.llm.stream_chat(messages, **llm_settings)
+        yield from self.answer_with_fact_check(
+            query, context,
+            self.res.llm.stream_chat(messages, **llm_settings))

@@ -53,9 +53,15 @@ class MultiTurnChatbot(QAChatbot):
         messages = [{"role": "system", "content": system},
                     {"role": "user", "content": query}]
         pieces: List[str] = []
-        for piece in self.res.llm.stream_chat(messages, **llm_settings):
-            pieces.append(piece)
-            yield piece
+
+        def capture():
+            for piece in self.res.llm.stream_chat(messages, **llm_settings):
+                pieces.append(piece)
+                yield piece
+
+        # Guardrail verdict (if configured) streams after the answer but
+        # only the answer itself is written back to conversation memory.
+        yield from self.answer_with_fact_check(query, context, capture())
         self._save_turn(query, "".join(pieces))
 
     def llm_chain(self, query: str, chat_history, **llm_settings

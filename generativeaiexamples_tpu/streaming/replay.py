@@ -73,20 +73,26 @@ def udp_send_iq(samples: np.ndarray, dst: tuple, pkt_size: int = 4096
 
 def udp_receive_iq(port: int, n_bytes: int, host: str = "127.0.0.1",
                    timeout: float = 5.0) -> np.ndarray:
-    """Collect n_bytes of IQ from UDP (BasicNetworkRxOp role)."""
+    """Collect n_bytes of IQ from UDP (BasicNetworkRxOp role). Uses the
+    native GIL-free ring drain when the C extension builds (native/
+    sdr_ring.c), else a plain recv loop."""
+    from generativeaiexamples_tpu.native.ring import make_ring
+
     sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     sock.bind((host, port))
-    sock.settimeout(timeout)
-    chunks = []
-    got = 0
+    ring = make_ring(max(n_bytes * 2, 1 << 20))
     try:
-        while got < n_bytes:
-            pkt, _ = sock.recvfrom(65536)
-            chunks.append(pkt)
-            got += len(pkt)
+        got = ring.recv_udp(sock, n_bytes,
+                            idle_timeout_ms=int(timeout * 1000))
+        if got < n_bytes:
+            raise TimeoutError(
+                f"IQ receive stalled: got {got} of {n_bytes} bytes "
+                f"within {timeout}s")
+        data = ring.pop(n_bytes)
     finally:
         sock.close()
-    return np.frombuffer(b"".join(chunks)[:n_bytes], np.complex64)
+        ring.close()
+    return np.frombuffer(data, np.complex64)
 
 
 class StreamPump:
