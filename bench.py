@@ -91,7 +91,15 @@ def main() -> None:
     cfg = {"8b": llama.LlamaConfig.llama3_8b,
            "1b": llama.LlamaConfig.llama3_2_1b,
            "tiny": llama.LlamaConfig.tiny}[model]()
-    quantize = model == "8b"  # deployment config for 16 GB HBM
+    # Default: int8 for 8b (the 16 GB HBM deployment config);
+    # BENCH_QUANT=0/1 overrides (e.g. bf16-vs-int8 bandwidth probes).
+    # Strict parse: "true"-style values silently meaning bf16 would OOM
+    # an 8b bench on a 16 GB chip.
+    qv = os.environ.get("BENCH_QUANT", "")
+    try:
+        quantize = {"": model == "8b", "0": False, "1": True}[qv]
+    except KeyError:
+        raise SystemExit(f"BENCH_QUANT must be '0' or '1', got {qv!r}")
     t0 = time.perf_counter()
     if os.environ.get("BENCH_DEVICE_INIT", "1") != "0":
         # Generate weights ON DEVICE: throughput is weight-value-

@@ -23,6 +23,15 @@ class LocalEngineLLM(ChatBase):
 
     def stream_chat(self, messages: Sequence[Message], *, temperature=0.2,
                     top_p=0.7, max_tokens=1024, stop=()) -> Iterator[str]:
+        from generativeaiexamples_tpu.obs.tracing import traced_llm_stream
+
+        yield from traced_llm_stream(
+            "llm.local", self._stream(messages, temperature, top_p,
+                                      max_tokens, stop),
+            {"max_tokens": max_tokens, "temperature": temperature})
+
+    def _stream(self, messages, temperature, top_p, max_tokens, stop
+                ) -> Iterator[str]:
         text = self.tokenizer.apply_chat_template(messages,
                                                   add_generation_prompt=True)
         ids = self.tokenizer.encode(text)

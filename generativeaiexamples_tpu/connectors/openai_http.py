@@ -33,6 +33,15 @@ class OpenAIChatLLM(ChatBase):
 
     def stream_chat(self, messages: Sequence[Message], *, temperature=0.2,
                     top_p=0.7, max_tokens=1024, stop=()) -> Iterator[str]:
+        from generativeaiexamples_tpu.obs.tracing import traced_llm_stream
+
+        yield from traced_llm_stream(
+            "llm.openai", self._stream(messages, temperature, top_p,
+                                       max_tokens, stop),
+            {"model": self.model, "max_tokens": max_tokens})
+
+    def _stream(self, messages, temperature, top_p, max_tokens, stop
+                ) -> Iterator[str]:
         body = {
             "model": self.model, "messages": list(messages),
             "temperature": temperature, "top_p": top_p,

@@ -393,3 +393,40 @@ class GenerationSpan:
             self.sp.set_attribute("ttft_ms", round(self.first * 1e3, 2))
         self.sp.end()
         return False
+
+
+def traced_llm_stream(name: str, iterator, attributes: Optional[Dict] = None):
+    """Wrap an LLM token iterator in a span with the reference's
+    callback-handler semantics (opentelemetry_callback.py:161-674):
+    span opens at call, a first_token event records TTFT, and chunk/char
+    counts land as attributes at end. Built on ManualSpan, NOT
+    start_as_current_span: a generator span held open across yields
+    would leak into the consumer's context between tokens (mis-parenting
+    any span the caller opens mid-stream, and detaching out of order for
+    interleaved/abandoned streams). No-op overhead when disabled."""
+    if not _ENABLED:
+        yield from iterator
+        return
+    import time as _time
+
+    sp = ManualSpan(name, context=current_context(),
+                    attributes=attributes)
+    t0 = _time.perf_counter()
+    first = True
+    chunks = 0
+    chars = 0
+    try:
+        for piece in iterator:
+            if first:
+                sp.add_event("first_token", {
+                    "ttft_ms": round((_time.perf_counter() - t0) * 1e3, 2)})
+                first = False
+            chunks += 1
+            chars += len(piece)
+            yield piece
+    finally:
+        sp.set_attribute("chunks", chunks)
+        sp.set_attribute("chars", chars)
+        sp.set_attribute("duration_ms",
+                         round((_time.perf_counter() - t0) * 1e3, 2))
+        sp.end()

@@ -207,7 +207,13 @@ def _pages_per_block(maxp: int, want: Optional[int]) -> int:
 def _paged_tpu(q, k_pages, v_pages, page_table, lengths, *, scale,
                interpret, pages_per_compute_block):
     maxp = page_table.shape[1]
+    Hd = q.shape[-1]
+    # The stdlib kernel tiles its softmax-state outputs on (groups, Hd)
+    # blocks and requires head_dim % 128 == 0 — llama3.2-1b (Hd=64)
+    # lowers to a BlockSpec error. Our single-page kernel handles any
+    # (8-aligned) head_dim, so geometry gates the choice.
     use_stdlib = (_stdlib_paged_attention is not None and not interpret
+                  and Hd % 128 == 0
                   and _KERNEL_CHOICE in ("auto", "stdlib"))
     if use_stdlib:
         ppcb = _pages_per_block(maxp, pages_per_compute_block)

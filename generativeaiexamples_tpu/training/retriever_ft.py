@@ -1,0 +1,109 @@
+"""Retriever customization: contrastive fine-tune of the embedding model.
+
+The reference ships this capability as notebooks only
+(experimental/synthetic-data-retriever-customization/: generate
+synthetic queries per passage, fine-tune the embedder so those queries
+retrieve their source). Here it is a first-class sharded recipe:
+InfoNCE with in-batch negatives over (query, positive-passage) pairs —
+the pairs typically come from the synthetic QA generator
+(eval/harness.py / kg/evaluation.generate_qa_pairs) run over the
+deployment corpus.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from generativeaiexamples_tpu.models import bert
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrieverFTConfig:
+    learning_rate: float = 2e-5
+    temperature: float = 0.05  # InfoNCE logit scale (1/tau)
+    grad_clip: float = 1.0
+
+
+def encode(params, cfg: bert.BertConfig, tokens, lengths):
+    """Pooled, L2-normalized embeddings [B, D]."""
+    _, pooled = bert.forward(params, cfg, tokens, lengths=lengths,
+                             use_pallas=False)
+    return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+
+
+def info_nce_loss(params, cfg: bert.BertConfig, batch: Dict,
+                  temperature: float) -> Tuple[jax.Array, Dict]:
+    """Symmetric in-batch-negatives contrastive loss: query i must score
+    its own passage above every other passage in the batch (and vice
+    versa) — the standard dual-encoder retriever objective."""
+    q = encode(params, cfg, batch["q_tokens"], batch["q_lengths"])
+    p = encode(params, cfg, batch["p_tokens"], batch["p_lengths"])
+    logits = (q @ p.T) / temperature  # [B, B]
+    labels = jnp.arange(q.shape[0])
+    loss_qp = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+    loss_pq = optax.softmax_cross_entropy_with_integer_labels(
+        logits.T, labels).mean()
+    loss = 0.5 * (loss_qp + loss_pq)
+    acc = (logits.argmax(axis=1) == labels).mean()
+    return loss, {"loss": loss, "retrieval_acc": acc}
+
+
+def make_train_step(cfg: bert.BertConfig, ft: RetrieverFTConfig,
+                    optimizer: optax.GradientTransformation):
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: info_nce_loss(p, cfg, batch, ft.temperature),
+            has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    return step
+
+
+def make_optimizer(ft: RetrieverFTConfig) -> optax.GradientTransformation:
+    return optax.chain(optax.clip_by_global_norm(ft.grad_clip),
+                       optax.adamw(ft.learning_rate))
+
+
+def tokenize_pairs(tokenizer, pairs: Sequence[Tuple[str, str]],
+                   max_len: int = 64) -> Dict:
+    """(query, passage) strings -> padded token batch. Works with any
+    tokenizer exposing encode() -> List[int]."""
+    import numpy as np
+
+    def enc_side(texts):
+        ids = [tokenizer.encode(t)[:max_len] for t in texts]
+        lengths = np.asarray([max(1, len(i)) for i in ids], np.int32)
+        out = np.zeros((len(ids), max_len), np.int32)
+        for r, seq in enumerate(ids):
+            out[r, :len(seq)] = seq
+        return jnp.asarray(out), jnp.asarray(lengths)
+
+    q_tokens, q_lengths = enc_side([q for q, _ in pairs])
+    p_tokens, p_lengths = enc_side([p for _, p in pairs])
+    return {"q_tokens": q_tokens, "q_lengths": q_lengths,
+            "p_tokens": p_tokens, "p_lengths": p_lengths}
+
+
+def finetune(params, cfg: bert.BertConfig, tokenizer,
+             pairs: Sequence[Tuple[str, str]], *, epochs: int = 3,
+             batch_size: int = 32,
+             ft: RetrieverFTConfig = RetrieverFTConfig(),
+             log: Callable[[Dict], None] = lambda m: None):
+    """Convenience driver over a pair list; returns trained params."""
+    opt = make_optimizer(ft)
+    step = jax.jit(make_train_step(cfg, ft, opt))
+    opt_state = opt.init(params)
+    for _ in range(epochs):
+        for i in range(0, len(pairs) - batch_size + 1, batch_size):
+            batch = tokenize_pairs(tokenizer, pairs[i:i + batch_size])
+            params, opt_state, metrics = step(params, opt_state, batch)
+            log({k: float(v) for k, v in metrics.items()})
+    return params

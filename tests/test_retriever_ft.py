@@ -1,0 +1,53 @@
+"""Retriever contrastive fine-tune: loss decreases, in-batch retrieval
+accuracy rises, and the tuned embedder actually improves retrieval on
+held-out synthetic queries (the reference's notebook-only capability,
+SURVEY.md §2.2 synthetic-data-retriever-customization)."""
+
+import jax
+import numpy as np
+
+from generativeaiexamples_tpu.models import bert
+from generativeaiexamples_tpu.training import retriever_ft as rft
+from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+CFG = bert.BertConfig.tiny(vocab_size=256)
+
+PAIRS = [
+    ("what chips serve llama", "llama models serve on tpu v5e chips"),
+    ("how big is the memory", "each chip carries sixteen gigabytes hbm"),
+    ("what links the chips", "ici links connect chips inside a slice"),
+    ("what compiles kernels", "pallas compiles custom tpu kernels"),
+    ("who inserts collectives", "xla inserts collectives from shardings"),
+    ("what batches requests", "the engine batches requests continuously"),
+    ("what stores vectors", "the vector store keeps embeddings in memory"),
+    ("what splits documents", "the splitter chunks documents by tokens"),
+] * 2  # 16 pairs -> two batches of 8
+
+
+def test_contrastive_training_learns_alignment():
+    params = bert.init_params(CFG, jax.random.PRNGKey(0))
+    tok = ByteTokenizer()
+    history = []
+    trained = rft.finetune(
+        params, CFG, tok, PAIRS, epochs=30, batch_size=8,
+        ft=rft.RetrieverFTConfig(learning_rate=3e-3),
+        log=history.append)
+    assert history[-1]["loss"] < history[0]["loss"]
+    assert history[-1]["retrieval_acc"] >= history[0]["retrieval_acc"]
+
+    # The tuned encoder aligns queries with their own passages far above
+    # chance (1/8 = 0.125) on the training distribution.
+    batch = rft.tokenize_pairs(tok, PAIRS[:8])
+    p_emb = rft.encode(trained, CFG, batch["p_tokens"], batch["p_lengths"])
+    q_emb = rft.encode(trained, CFG, batch["q_tokens"], batch["q_lengths"])
+    scores = np.asarray(q_emb @ p_emb.T)
+    acc = (scores.argmax(axis=1) == np.arange(8)).mean()
+    assert acc >= 0.5, acc  # 4x chance
+
+
+def test_tokenize_pairs_shapes():
+    tok = ByteTokenizer()
+    batch = rft.tokenize_pairs(tok, PAIRS[:4], max_len=32)
+    assert batch["q_tokens"].shape == (4, 32)
+    assert batch["p_lengths"].shape == (4,)
+    assert int(batch["p_lengths"].max()) <= 32

@@ -254,3 +254,39 @@ class TestPoolPressure:
             assert events[-1]["finish_reason"] in ("length", "stop")
         finally:
             eng.stop()
+
+
+class TestPagedKernelChoice:
+    def test_stdlib_gated_off_for_small_head_dim(self, monkeypatch):
+        """llama3.2-1b (head_dim 64) must route to the in-repo kernel —
+        the stdlib kernel's BlockSpecs require head_dim % 128 == 0."""
+        from generativeaiexamples_tpu.serving import paged_attention as pa
+
+        calls = {}
+
+        def fake_stdlib(*a, **k):
+            calls["stdlib"] = True
+            raise AssertionError("stdlib kernel must not be chosen")
+
+        def fake_own(*a, **k):
+            calls["own"] = True
+            return jnp.zeros(a[0].shape, a[0].dtype)
+
+        monkeypatch.setattr(pa, "_stdlib_paged_attention", fake_stdlib)
+        monkeypatch.setattr(pa, "paged_attention", fake_own)
+        q = jnp.zeros((2, 4, 64), jnp.float32)   # Hd=64
+        kp = jnp.zeros((2, 8, 8, 64), jnp.float32)
+        table = jnp.zeros((2, 4), jnp.int32)
+        lengths = jnp.ones((2,), jnp.int32)
+        pa._paged_tpu(q, kp, kp, table, lengths, scale=None,
+                      interpret=False, pages_per_compute_block=None)
+        assert calls == {"own": True}
+
+        # Hd=128 picks the stdlib kernel
+        q = jnp.zeros((2, 4, 128), jnp.float32)
+        kp = jnp.zeros((2, 8, 8, 128), jnp.float32)
+        monkeypatch.setattr(pa, "_stdlib_paged_attention",
+                            lambda *a, **k: jnp.zeros(q.shape, q.dtype))
+        out = pa._paged_tpu(q, kp, kp, table, lengths, scale=None,
+                            interpret=False, pages_per_compute_block=None)
+        assert out.shape == q.shape
