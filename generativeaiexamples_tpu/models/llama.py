@@ -224,7 +224,8 @@ jax.tree_util.register_dataclass(
 
 
 def _layer(cfg: LlamaConfig, x, ln1, ln2, wq, wk, wv, wo, w_gate, w_up, w_down,
-           positions, kv, kv_lengths, attn_lengths, causal, q_offset, use_pallas):
+           positions, kv, kv_lengths, attn_lengths, causal, q_offset, use_pallas,
+           mesh=None):
     """One transformer block. x [B,S,D]. kv: (k_cache, v_cache) for this
     layer ([B,KH,S_max,Hd]) or None. Returns (x_out, new_kv)."""
     B, S, D = x.shape
@@ -239,18 +240,18 @@ def _layer(cfg: LlamaConfig, x, ln1, ln2, wq, wk, wv, wo, w_gate, w_up, w_down,
 
     if kv is None:
         out = attn_ops.attention(q, k, v, causal=causal, lengths=attn_lengths,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, mesh=mesh)
         new_kv = (k, v)
     else:
         kc, vc = kv
         # Scatter the S new tokens at [kv_lengths, kv_lengths+S) per batch.
         idx = kv_lengths[:, None] + jnp.arange(S)[None, :]  # [B, S]
         bidx = jnp.arange(B)[:, None]
-        kc = kc.at[bidx, :, idx, :].set(k.transpose(0, 2, 1, 3))
-        vc = vc.at[bidx, :, idx, :].set(v.transpose(0, 2, 1, 3))
+        kc = kc.at[bidx, :, idx, :].set(k.transpose(0, 2, 1, 3).astype(kc.dtype))
+        vc = vc.at[bidx, :, idx, :].set(v.transpose(0, 2, 1, 3).astype(vc.dtype))
         out = attn_ops.attention(q, kc, vc, causal=causal,
                                  lengths=attn_lengths, q_offset=q_offset,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, mesh=mesh)
         new_kv = (kc, vc)
 
     out = out.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
@@ -269,6 +270,7 @@ def forward(
     kv_cache: Optional[KVCache] = None,
     lengths: Optional[jax.Array] = None,  # [B] valid tokens in `tokens`
     use_pallas: Optional[bool] = None,
+    mesh=None,  # multi-device: routes kernels through shard_map
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Token ids -> logits. Three modes:
 
@@ -300,7 +302,7 @@ def forward(
         (ln1, ln2, wq, wk, wv, wo, w_gate, w_up, w_down), kv = layer
         x, new_kv = _layer(cfg, x, ln1, ln2, wq, wk, wv, wo, w_gate, w_up,
                            w_down, positions, kv, kv_lengths, attn_lengths,
-                           causal, q_offset, use_pallas)
+                           causal, q_offset, use_pallas, mesh)
         return x, new_kv
 
     weights = (lp["ln1"], lp["ln2"], lp["wq"], lp["wk"], lp["wv"], lp["wo"],

@@ -47,10 +47,11 @@ _LOG = logging.getLogger(__name__)
 
 
 class PromptTooLongError(ValueError):
-    """Prompt exceeds the engine's largest prefill bucket. Raised at
-    submit() so callers reject at the API boundary (the reference caps
-    input at the API, common/server.py:63,85) instead of the engine
-    silently truncating."""
+    """Prompt exceeds the engine's page capacity (prompts beyond the
+    largest prefill bucket go through chunked prefill, so the cap is
+    max_pages * page_size - 1). Raised at submit() so callers reject at
+    the API boundary (the reference caps input at the API,
+    common/server.py:63,85) instead of the engine silently truncating."""
 
 
 @dataclasses.dataclass
@@ -266,12 +267,17 @@ class LLMEngine:
     # -- public API --------------------------------------------------------
 
     def submit(self, req: GenRequest) -> GenRequest:
-        max_prompt = self.buckets[-1]
+        # Prompts beyond the largest bucket go through CHUNKED prefill
+        # (bucket-size pieces into a contiguous scratch cache, then one
+        # scatter into the page pool), so the real ceiling is the page
+        # capacity minus one generated token.
+        max_prompt = self.max_pages * self.ecfg.page_size - 1
         if len(req.prompt_ids) > max_prompt:
             if not req.truncate_prompt:
                 raise PromptTooLongError(
                     f"prompt is {len(req.prompt_ids)} tokens; engine max is "
-                    f"{max_prompt} (largest prefill bucket)")
+                    f"{max_prompt} (page capacity minus one generated "
+                    f"token)")
             req.prompt_ids = req.prompt_ids[-max_prompt:]
         with self._lock:
             self.waiting.append(req)
@@ -368,7 +374,6 @@ class LLMEngine:
                     break
                 req = self.waiting.popleft()
             ids = req.prompt_ids or [0]
-            bucket = self._bucket_for(len(ids))
             seq = SequencePages(self.allocator, self.pool.page_size,
                                 self.max_pages)
             try:
@@ -383,6 +388,18 @@ class LLMEngine:
             # the real _Slot replaces the placeholder at dispatch.
             placeholder = _Slot(req, seq, None)
             self.slots[slot_idx] = placeholder
+            if len(ids) > self.buckets[-1]:
+                try:
+                    self._prefill_long(req, slot_idx, seq, ids)
+                except Exception:
+                    _LOG.exception("chunked prefill failed")
+                    self.slots[slot_idx] = None
+                    seq.release()
+                    req.stream.put({"text": "", "token_id": -1,
+                                    "finished": True,
+                                    "finish_reason": "error"})
+                continue
+            bucket = self._bucket_for(len(ids))
             groups.setdefault(bucket, []).append((req, slot_idx, seq, ids))
         did = False
         for bucket, entries in groups.items():
@@ -460,6 +477,59 @@ class LLMEngine:
             slot = _Slot(req, seq, StreamDetokenizer(self.tokenizer),
                          span=span)
             self.slots[slot_idx] = slot
+
+    def _prefill_long(self, req: GenRequest, slot_idx: int,
+                      seq: SequencePages, ids: List[int]) -> None:
+        """Chunked prefill for prompts beyond the largest bucket
+        (SURVEY.md §5.7 — the reference has no long-context story at
+        all): bucket-size chunks run through a contiguous scratch
+        KVCache with offset queries (the flash kernel's shifted causal
+        diagonal), then ONE scatter moves the finished cache into this
+        sequence's pages and the first token samples on device."""
+        from generativeaiexamples_tpu.models.llama import KVCache
+        from generativeaiexamples_tpu.obs.tracing import ManualSpan
+
+        ps = self.pool.page_size
+        chunk = self.buckets[-1]
+        S_total = -(-len(ids) // chunk) * chunk
+        # Model dtype, NOT kv dtype: llama.forward's scatter writes
+        # model-dtype k/v; cache_to_pool casts once at the page write.
+        cache = KVCache.zeros(self.cfg, 1, max_len=S_total)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sh = NamedSharding(self.mesh,
+                                  P(None, None, "tensor", None, None))
+            cache = KVCache(jax.device_put(cache.k, kv_sh),
+                            jax.device_put(cache.v, kv_sh),
+                            jax.device_put(cache.lengths, self._replicated))
+        logits = None
+        for i in range(0, len(ids), chunk):
+            part = ids[i:i + chunk]
+            tok = np.zeros((1, chunk), np.int32)
+            tok[0, :len(part)] = part
+            logits, cache = engine_model.prefill_chunk_step(
+                self.params, self.cfg, cache, self._put(tok),
+                self._put(np.int32(len(part))), self.use_pallas,
+                mesh=self.mesh)
+        row = np.zeros((S_total // ps,), np.int32)  # padding -> sink 0
+        row[:len(seq.pages)] = seq.pages
+        self.pool = engine_model.cache_to_pool(self.pool, cache, self.cfg,
+                                               self._put(row))
+        greedy = req.temperature <= 0.0
+        flags = (True, False, False) if greedy else (False, True, True)
+        tok0 = engine_model.sample_token(
+            logits, req.temperature, req.top_p, req.top_k,
+            self._next_key(), *flags)
+        self._last_tokens = engine_model.set_last_token(
+            self._last_tokens, self._put(np.int32(slot_idx)), tok0)
+        span = ManualSpan("engine.generate", context=req.trace_context,
+                          attributes={"prompt_tokens": len(ids),
+                                      "chunked_prefill": True,
+                                      "request_id": req.request_id})
+        self.slots[slot_idx] = _Slot(req, seq,
+                                     StreamDetokenizer(self.tokenizer),
+                                     span=span)
 
     def _dispatch_decode(self) -> bool:
         """Dispatch (async) K fused decode steps over the slot batch.

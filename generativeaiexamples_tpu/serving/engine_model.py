@@ -326,3 +326,51 @@ def set_last_token(last_tokens: jax.Array, idx: jax.Array,
                    tok: jax.Array) -> jax.Array:
     """last_tokens[idx] = tok, on device (admission after prefill)."""
     return last_tokens.at[idx].set(tok.astype(last_tokens.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill (long prompts: larger than the biggest prefill bucket)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),
+                   donate_argnames=("cache",))
+def prefill_chunk_step(
+    params, cfg: LlamaConfig, cache,
+    tokens: jax.Array,  # [1, C] chunk (padded to the chunk bucket)
+    valid: jax.Array,   # [] valid tokens in this chunk
+    use_pallas: Optional[bool] = None,
+    mesh=None,
+) -> Tuple[jax.Array, "object"]:
+    """One chunk of a long prompt through the contiguous scratch cache.
+    llama.forward's cached-continuation mode does the work: k/v land at
+    absolute positions cache.lengths + i, queries run with
+    q_offset=cache.lengths (the flash kernel handles the shifted causal
+    diagonal). Returns (last-valid-token logits [V], cache)."""
+    from generativeaiexamples_tpu.models import llama
+
+    logits, cache = llama.forward(params, cfg, tokens, kv_cache=cache,
+                                  lengths=valid[None],
+                                  use_pallas=use_pallas, mesh=mesh)
+    last = jnp.take_along_axis(
+        logits, (valid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
+    return last[0, 0], cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("pool",))
+def cache_to_pool(
+    pool: PagePool, cache, cfg: LlamaConfig,
+    table_row: jax.Array,  # [S_total_bucket // page_size] page ids
+) -> PagePool:
+    """Scatter a finished scratch cache (batch 1) into the paged pool —
+    the long-prompt twin of prefill_step's page write."""
+    ps = pool.page_size
+    L, _, KH, S, Hd = cache.k.shape
+    npages = S // ps
+    kw = cache.k[:, 0].reshape(L, KH, npages, ps, Hd).transpose(0, 2, 1, 3, 4)
+    vw = cache.v[:, 0].reshape(L, KH, npages, ps, Hd).transpose(0, 2, 1, 3, 4)
+    li = jnp.arange(L)[:, None]
+    k = pool.k.at[li, :, table_row[None, :]].set(kw.astype(pool.k.dtype))
+    v = pool.v.at[li, :, table_row[None, :]].set(vw.astype(pool.v.dtype))
+    return PagePool(k, v, ps)

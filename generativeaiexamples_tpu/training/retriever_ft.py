@@ -97,13 +97,27 @@ def finetune(params, cfg: bert.BertConfig, tokenizer,
              batch_size: int = 32,
              ft: RetrieverFTConfig = RetrieverFTConfig(),
              log: Callable[[Dict], None] = lambda m: None):
-    """Convenience driver over a pair list; returns trained params."""
+    """Convenience driver over a pair list; returns trained params.
+    Small corpora clamp the batch to the corpus (never a silent zero
+    training steps); a sub-batch tail is dropped with a warning
+    (variable shapes would recompile the step per epoch)."""
+    import logging
+
+    if not pairs:
+        raise ValueError("finetune needs at least one (query, passage) pair")
+    batch_size = min(batch_size, len(pairs))
+    tail = len(pairs) % batch_size
+    if tail:
+        logging.getLogger(__name__).warning(
+            "dropping %d trailing pairs (< batch_size %d)", tail, batch_size)
+    # Tokenize every batch ONCE (host work does not repeat per epoch).
+    batches = [tokenize_pairs(tokenizer, pairs[i:i + batch_size])
+               for i in range(0, len(pairs) - batch_size + 1, batch_size)]
     opt = make_optimizer(ft)
     step = jax.jit(make_train_step(cfg, ft, opt))
     opt_state = opt.init(params)
     for _ in range(epochs):
-        for i in range(0, len(pairs) - batch_size + 1, batch_size):
-            batch = tokenize_pairs(tokenizer, pairs[i:i + batch_size])
+        for batch in batches:
             params, opt_state, metrics = step(params, opt_state, batch)
             log({k: float(v) for k, v in metrics.items()})
     return params

@@ -1,0 +1,69 @@
+"""Ring attention == dense attention, sharded over the virtual mesh's
+sequence axis (the long-context/sequence-parallel capability the task
+calls first-class; absent from the reference entirely, SURVEY.md §5.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.config.schema import MeshConfig
+from generativeaiexamples_tpu.ops.attention import mha_reference
+from generativeaiexamples_tpu.ops.ring_attention import (
+    ring_attention_sharded)
+from generativeaiexamples_tpu.parallel.mesh import build_mesh
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return build_mesh(MeshConfig(ici_sequence=4, ici_tensor=1, ici_data=-1),
+                      devices=jax.devices()[:8])
+
+
+def _rand(shape, seed):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, seq_mesh, causal):
+        B, H, S, D = 2, 4, 64, 16
+        q, k, v = (_rand((B, H, S, D), i) for i in range(3))
+        want = mha_reference(q, k, v, causal=causal)
+        got = ring_attention_sharded(q, k, v, seq_mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_gqa(self, seq_mesh):
+        B, H, KH, S, D = 1, 8, 2, 32, 16
+        q = _rand((B, H, S, D), 0)
+        k = _rand((B, KH, S, D), 1)
+        v = _rand((B, KH, S, D), 2)
+        want = mha_reference(q, k, v, causal=True)
+        got = ring_attention_sharded(q, k, v, seq_mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_under_jit_with_grad(self, seq_mesh):
+        """Ring attention must be differentiable (training long-context)
+        and match dense gradients."""
+        B, H, S, D = 1, 2, 32, 8
+        q, k, v = (_rand((B, H, S, D), i + 10) for i in range(3))
+
+        def loss_ring(q, k, v):
+            return ring_attention_sharded(q, k, v, seq_mesh).sum()
+
+        def loss_dense(q, k, v):
+            return mha_reference(q, k, v, causal=True).sum()
+
+        g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+        g_dense = jax.grad(loss_dense)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                                   atol=5e-4)
+
+    def test_indivisible_length_rejected(self, seq_mesh):
+        q = _rand((1, 2, 30, 8), 0)  # 30 % 4 != 0
+        with pytest.raises(ValueError, match="must divide"):
+            ring_attention_sharded(q, q, q, seq_mesh)

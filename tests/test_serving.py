@@ -290,3 +290,50 @@ class TestPagedKernelChoice:
         out = pa._paged_tpu(q, kp, kp, table, lengths, scale=None,
                             interpret=False, pages_per_compute_block=None)
         assert out.shape == q.shape
+
+
+class TestChunkedPrefill:
+    def test_long_prompt_matches_offline_greedy(self):
+        """A prompt LARGER than the biggest prefill bucket goes through
+        chunked prefill and must produce exactly the offline greedy
+        continuation (VERDICT r1 §5.7: long-context first-class)."""
+        params = llama.init_params(TINY, jax.random.PRNGKey(3))
+        ecfg = EngineConfig(max_batch_size=2, max_seq_len=96, page_size=8,
+                            prefill_buckets=(16,),
+                            decode_steps_per_dispatch=2,
+                            compile_cache_dir="")
+        eng = LLMEngine(params, TINY, ByteTokenizer(), ecfg,
+                        use_pallas=False).start()
+        try:
+            prompt = [(i * 7) % TINY.vocab_size for i in range(50)]  # > 16
+            got = [e["token_id"]
+                   for e in eng.generate_stream(prompt, max_new_tokens=8)
+                   if e["token_id"] >= 0]
+            want = np.asarray(llama.greedy_generate(
+                params, TINY, jnp.asarray([prompt]), 8))[0, len(prompt):]
+            np.testing.assert_array_equal(got, want)
+
+            # short prompts still take the batched-bucket path alongside
+            short = [5, 6, 7]
+            got2 = [e["token_id"]
+                    for e in eng.generate_stream(short, max_new_tokens=4)
+                    if e["token_id"] >= 0]
+            want2 = np.asarray(llama.greedy_generate(
+                params, TINY, jnp.asarray([short]), 4))[0, len(short):]
+            np.testing.assert_array_equal(got2, want2)
+        finally:
+            eng.stop()
+
+    def test_overlong_prompt_rejected_at_page_capacity(self):
+        params = llama.init_params(TINY, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch_size=2, max_seq_len=32, page_size=8,
+                            prefill_buckets=(16,), compile_cache_dir="")
+        eng = LLMEngine(params, TINY, ByteTokenizer(), ecfg,
+                        use_pallas=False)
+        import pytest
+
+        from generativeaiexamples_tpu.serving.engine import (
+            GenRequest, PromptTooLongError)
+
+        with pytest.raises(PromptTooLongError):
+            eng.submit(GenRequest(prompt_ids=list(range(40))))  # > 31

@@ -152,3 +152,17 @@ def test_llama3_70b_int8_tp8_decode_compiles(eight_dev_mesh):
     # The partitioned executable exists and its per-device argument
     # shards are 1/8th of the weight bytes on the tensor axis.
     assert compiled is not None
+
+
+def test_tp_chunked_prefill_matches_single_device(eight_dev_mesh):
+    """Long prompts (chunked prefill path) under TP=8 produce the same
+    tokens as the single-device engine."""
+    cfg = tp_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    long_prompt = [(i * 5 + 3) % cfg.vocab_size for i in range(100)]  # > 64
+
+    ref = run_engine(params, cfg, mesh=None, prompts=[long_prompt])
+    sharded = shd.shard_llama_params(params, cfg, eight_dev_mesh)
+    got = run_engine(sharded, cfg, mesh=eight_dev_mesh,
+                     prompts=[long_prompt])
+    assert ref == got
