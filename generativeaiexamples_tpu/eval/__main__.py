@@ -1,0 +1,121 @@
+"""Evaluation CLI: `python -m generativeaiexamples_tpu.eval`.
+
+The reference's 4-stage eval flow as one command
+(tools/evaluation/rag_evaluator/main.py + the 01-04 notebooks,
+SURVEY.md §3.6): [1] synthesize QA pairs from the corpus, [2] upload
+the corpus and generate answers through a running chain server,
+[3] RAGAS-style metrics + harmonic ragas_score, [4] LLM-judge Likert
+ratings. Emits the same JSON row schema the reference's harness writes,
+so existing analysis tooling reads it unchanged.
+
+Hermetic dry run (fakes, no server):
+    python -m generativeaiexamples_tpu.eval --docs README.md --offline
+
+Against a live chain server:
+    python -m generativeaiexamples_tpu.eval --docs docs/*.md \\
+        --server http://localhost:8081 --out eval_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+_LOG = logging.getLogger(__name__)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--docs", nargs="+", required=True,
+                    help="corpus files to evaluate over")
+    ap.add_argument("--server", default="http://localhost:8081",
+                    help="chain server base URL")
+    ap.add_argument("--offline", action="store_true",
+                    help="hermetic: fake LLM/embedder, in-process pipeline "
+                         "instead of a server (smoke/CI mode)")
+    ap.add_argument("--max-pairs", type=int, default=8)
+    ap.add_argument("--out", default="eval_report.json")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+
+    from generativeaiexamples_tpu.config.wizard import load_config
+    from generativeaiexamples_tpu.connectors import factory
+    from generativeaiexamples_tpu.eval import harness
+    from generativeaiexamples_tpu.rag.documents import load_document
+    from generativeaiexamples_tpu.rag.splitter import get_text_splitter
+
+    cfg = load_config(None)
+    if args.offline:
+        from generativeaiexamples_tpu.connectors.fakes import (
+            EchoLLM, HashEmbedder)
+
+        # Scripted fakes: enough structure to exercise all four stages
+        # (patterns match the ACTUAL harness/metrics prompts).
+        llm = EchoLLM(script=[
+            ("question-answer pair",
+             '{"question": "What does the passage describe?", '
+             '"answer": "The main subject of the passage."}'),
+            ("You are grading answers",
+             '{"rating": 4, "explanation": "close to the reference"}'),
+        ])
+        embedder = HashEmbedder(64)
+    else:
+        llm, embedder = factory.get_llm(cfg), factory.get_embedder(cfg)
+
+    # [1] synthetic QA from corpus chunks (data_generator.py role)
+    splitter = get_text_splitter(cfg)
+    chunks = []
+    for path in args.docs:
+        for d in load_document(path, path):
+            chunks.extend(splitter.split(d.text))
+    _LOG.info("corpus: %d files -> %d chunks", len(args.docs), len(chunks))
+    qa_rows = harness.generate_synthetic_qa(llm, chunks,
+                                            n_pairs=args.max_pairs)
+    if not qa_rows:
+        print("no QA pairs generated (is the LLM reachable?)",
+              file=sys.stderr)
+        return 1
+    _LOG.info("synthesized %d QA pairs", len(qa_rows))
+
+    # [2] answers through the chain server (llm_answer_generator.py role)
+    if args.offline:
+        from generativeaiexamples_tpu.pipelines.base import get_example_class
+        from generativeaiexamples_tpu.pipelines.resources import Resources
+
+        res = Resources(cfg, llm=llm, embedder=embedder, reranker=None)
+        ex = get_example_class("developer_rag")(res)
+        for path in args.docs:
+            ex.ingest_docs(path, path)
+        rows = []
+        for qa in qa_rows:
+            ctx = [h["content"] for h in
+                   ex.document_search(qa["question"], 4)]
+            answer = "".join(ex.rag_chain(qa["question"], [],
+                                          max_tokens=256))
+            # Same row schema as the server path (generate_answers
+            # spreads the full QA row in).
+            rows.append({**qa, "generated_answer": answer,
+                         "retrieved_context": ctx})
+    else:
+        client = harness.ChainServerClient(args.server)
+        for path in args.docs:
+            client.upload(path)
+        rows = harness.generate_answers(client, qa_rows)
+
+    # [3] RAGAS-style metrics + [4] LLM judge (harness.run_eval owns
+    # the report shape; evaluate() computes ragas_score itself)
+    report = harness.run_eval(llm, embedder, rows)
+    report["rows"] = rows
+    harness.save_report(report, args.out)
+    print(json.dumps({"ragas_score": report["ragas"].get("ragas_score"),
+                      "llm_judge_mean":
+                          report["llm_judge"].get("mean_rating"),
+                      "n_questions": len(rows), "report": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

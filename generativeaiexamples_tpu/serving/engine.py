@@ -277,7 +277,12 @@ class LLMEngine:
             # variant in live traffic.
             group_sizes.append(n)
         if ks is None:
-            ks = sorted({1, max(1, self.ecfg.decode_steps_per_dispatch)})
+            # _dispatch_decode rounds K DOWN to a power of two; warm the
+            # variant that will actually dispatch.
+            k_live = max(1, self.ecfg.decode_steps_per_dispatch)
+            while k_live & (k_live - 1):
+                k_live &= k_live - 1
+            ks = sorted({1, k_live})
         flag_sets = [(True, False, False)]
         if sampled:
             flag_sets.append((False, True, True))
@@ -320,6 +325,7 @@ class LLMEngine:
             s_tot = chunk
             while s_tot <= self.max_pages * ps:
                 cache = KVCache.zeros(self.cfg, 1, max_len=s_tot)
+                cache = self._place_scratch_cache(cache)
                 _, cache = engine_model.prefill_chunk_step(
                     self.params, self.cfg, cache,
                     self._put(np.zeros((1, chunk), np.int32)),
@@ -584,15 +590,8 @@ class LLMEngine:
         # device queue) and a COLD S_total compiles here — warm the
         # variants at boot via warmup(long_prompts=True) when long
         # prompts are expected in live traffic.
-        cache = KVCache.zeros(self.cfg, 1, max_len=S_total)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            kv_sh = NamedSharding(self.mesh,
-                                  P(None, None, "tensor", None, None))
-            cache = KVCache(jax.device_put(cache.k, kv_sh),
-                            jax.device_put(cache.v, kv_sh),
-                            jax.device_put(cache.lengths, self._replicated))
+        cache = self._place_scratch_cache(
+            KVCache.zeros(self.cfg, 1, max_len=S_total))
         logits = None
         for i in range(0, len(ids), chunk):
             part = ids[i:i + chunk]
@@ -620,6 +619,22 @@ class LLMEngine:
         self.slots[slot_idx] = _Slot(req, seq,
                                      StreamDetokenizer(self.tokenizer),
                                      span=span)
+
+    def _place_scratch_cache(self, cache):
+        """Shard a chunked-prefill scratch cache like the KV pool (kv
+        heads on tensor). warmup and the live path MUST place
+        identically — jit specializes on input sharding, so a
+        differently-placed warmup variant would never be reused."""
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from generativeaiexamples_tpu.models.llama import KVCache
+
+        kv_sh = NamedSharding(self.mesh, P(None, None, "tensor", None, None))
+        return KVCache(jax.device_put(cache.k, kv_sh),
+                       jax.device_put(cache.v, kv_sh),
+                       jax.device_put(cache.lengths, self._replicated))
 
     def _dispatch_decode(self) -> bool:
         """Dispatch (async) K fused decode steps over the slot batch.

@@ -1,0 +1,30 @@
+"""The 4-stage evaluation CLI runs hermetically end-to-end and emits the
+reference's JSON row schema (tools/evaluation main.py role)."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_offline_eval_cli(tmp_path):
+    doc = tmp_path / "corpus.txt"
+    doc.write_text("TPU v5e chips carry sixteen gigabytes of HBM and talk "
+                   "over ICI links for collectives and ring schedules.")
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "generativeaiexamples_tpu.eval",
+         "--docs", str(doc), "--offline", "--max-pairs", "2",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=ROOT, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["n_questions"] >= 1
+    report = json.loads(out.read_text())
+    # the reference's row schema, field for field
+    row = report["rows"][0]
+    assert set(row) >= {"question", "generated_answer",
+                        "retrieved_context", "ground_truth_answer"}
+    assert "ragas" in report and "llm_judge" in report
