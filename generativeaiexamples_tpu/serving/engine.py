@@ -11,14 +11,19 @@ page state — SURVEY.md §5.2 calls out that the reference has no
 concurrency discipline; this one is explicit):
 
   submit() -> waiting deque
-  loop:  admit waiting requests into free slots (one bucketed prefill
-         each, first token sampled immediately — TTFT = submit->here),
-         then one decode_step over ALL active slots (fixed batch shape,
-         inactive slots masked to the page-0 sink), sample, stream out,
-         retire finished slots.
+  loop:  admit waiting requests (same-bucket admissions prefill in ONE
+         batched dispatch; prompts beyond the largest bucket go through
+         chunked prefill); keep up to pipeline_depth fused decode
+         blocks in flight over ALL active slots (fixed batch shape,
+         inactive slots masked to the page-0 sink, sampling on device,
+         tokens chained device-side); block only on fetching the OLDEST
+         in-flight block; emit/retire from it. A slot awaiting its
+         first token gets a K=1 block so TTFT never rides a full
+         K-step block.
 
-Shapes are always (bucket,) for prefill and (max_batch, max_pages) for
-decode, so steady state never recompiles.
+Shapes are always (group, bucket) for prefill and (max_batch,
+max_pages) for decode, padded to power-of-two groups/K-buckets, so
+steady state never recompiles; warmup() precompiles every variant.
 """
 
 from __future__ import annotations
