@@ -15,11 +15,9 @@ XLA fuses the int8->bf16 convert + scale into the matmul's weight read.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 
 @dataclasses.dataclass

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import io
 import wave
-from typing import Iterator, List, Optional, Protocol
+from typing import List, Optional, Protocol
 
 import numpy as np
 

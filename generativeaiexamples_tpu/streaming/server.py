@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import json
 import logging
-from typing import Optional
-
 from aiohttp import web
 
 from generativeaiexamples_tpu.streaming.accumulator import (

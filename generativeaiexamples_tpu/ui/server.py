@@ -16,8 +16,6 @@ import json
 import logging
 import os
 import tempfile
-from typing import Optional
-
 from aiohttp import web
 
 from generativeaiexamples_tpu.ui.chat_client import ChatClient
