@@ -1,0 +1,24 @@
+"""The wheel ships every non-Python runtime artifact: the playground's
+static pages and the native C source (a pip-installed deployment
+otherwise serves 404s and the SDR ring can never build)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_data_ships():
+    with tempfile.TemporaryDirectory() as td:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pip", "install", ".", "--no-deps",
+             "--no-build-isolation", "-q", "--target", td],
+            cwd=ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        pkg = os.path.join(td, "generativeaiexamples_tpu")
+        for rel in ("ui/static/converse.html", "ui/static/converse.js",
+                    "ui/static/kb.html", "ui/static/kb.js",
+                    "ui/static/app.css", "native/sdr_ring.c"):
+            assert os.path.exists(os.path.join(pkg, rel)), f"missing {rel}"
