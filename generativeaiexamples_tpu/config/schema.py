@@ -172,7 +172,7 @@ class EngineConfig:
 
     weights_path: str = ""  # HF snapshot dir or orbax checkpoint
     dtype: str = "bfloat16"
-    kv_dtype: str = "bfloat16"
+    kv_dtype: str = "bfloat16"  # bfloat16 | int8 (narrow per-token scales)
     quantize_weights: str = "none"  # none | int8
     max_batch_size: int = 8
     max_seq_len: int = 8192

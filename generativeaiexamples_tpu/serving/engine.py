@@ -242,16 +242,18 @@ class LLMEngine:
         self.max_pages = self.ecfg.max_seq_len // ps
         if n_pages is None:
             n_pages = self.ecfg.max_batch_size * self.max_pages + 1
-        kv_sharding = None
+        kv_sharding = scale_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
             from generativeaiexamples_tpu.serving import sharding as shd
 
             kv_sharding = NamedSharding(self.mesh, shd.KV_POOL_SPEC)
+            scale_sharding = NamedSharding(self.mesh, shd.KV_SCALE_SPEC)
         self.pool = PagePool.zeros(cfg, n_pages, ps,
                                    dtype=jnp.dtype(self.ecfg.kv_dtype),
-                                   sharding=kv_sharding)
+                                   sharding=kv_sharding,
+                                   scale_sharding=scale_sharding)
         self.allocator = PageAllocator(n_pages)
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()

@@ -32,6 +32,27 @@ from generativeaiexamples_tpu.serving.paged_attention import (
     paged_attention_dispatch)
 
 
+def _write_prefill_pages(pool: PagePool, kw, vw, li, table_idx) -> PagePool:
+    """Scatter page-shaped prefill k/v (value layout [..., KH, ps, Hd],
+    matching the advanced-index pattern `pool.k.at[li, :, table_idx]`)
+    into the pool; int8 pools quantize per (kv-head, token) row with
+    narrow scales (serving/paged_attention_int8.py)."""
+    if pool.quantized:
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            quantize_kv)
+
+        kq, ks = quantize_kv(kw)
+        vq, vs = quantize_kv(vw)
+        return PagePool(pool.k.at[li, :, table_idx].set(kq),
+                        pool.v.at[li, :, table_idx].set(vq),
+                        pool.page_size,
+                        pool.k_s.at[li, :, table_idx].set(ks),
+                        pool.v_s.at[li, :, table_idx].set(vs))
+    return PagePool(pool.k.at[li, :, table_idx].set(kw.astype(pool.k.dtype)),
+                    pool.v.at[li, :, table_idx].set(vw.astype(pool.v.dtype)),
+                    pool.page_size)
+
+
 def _project_qkv(cfg: LlamaConfig, h, w, positions):
     B, S, _ = h.shape
     H, KH, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -97,12 +118,11 @@ def prefill_step(
     kw = k_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
     vw = v_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
     li = jnp.arange(L)[:, None]
-    k = pool.k.at[li, :, table_row[None, :]].set(kw.astype(pool.k.dtype))
-    v = pool.v.at[li, :, table_row[None, :]].set(vw.astype(pool.v.dtype))
+    pool = _write_prefill_pages(pool, kw, vw, li, table_row[None, :])
     last = jnp.take_along_axis(
         x, (length - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)  # [1,1,D]
     logits = _logits(cfg, params, last)[0, 0]
-    return logits, PagePool(k, v, ps)
+    return logits, pool
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas",
@@ -155,8 +175,7 @@ def prefill_batch_step(
     kw = k_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
     vw = v_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
     li = jnp.arange(L)[:, None, None]
-    k = pool.k.at[li, :, table_rows[None, :, :]].set(kw.astype(pool.k.dtype))
-    v = pool.v.at[li, :, table_rows[None, :, :]].set(vw.astype(pool.v.dtype))
+    pool = _write_prefill_pages(pool, kw, vw, li, table_rows[None, :, :])
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
     logits = _logits(cfg, params, last)[:, 0]  # [N, V]
@@ -164,7 +183,7 @@ def prefill_batch_step(
     sp = SamplingParams(temperature, top_p, top_k)
     toks = sample(logits, sp, key, all_greedy=all_greedy,
                   any_top_k=any_top_k, any_top_p=any_top_p)
-    return toks, PagePool(k, v, ps)
+    return toks, pool
 
 
 @functools.partial(jax.jit, donate_argnames=("last_tokens",))
@@ -201,23 +220,35 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
     kh_idx = jnp.arange(cfg.n_kv_heads)[:, None]  # [KH, 1] -> bcast [KH, B]
 
     x = params["tok_emb"][tokens[:, None]].astype(cfg.dtype)  # [B, 1, D]
+    quantized = pool.quantized
+    if quantized:
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            quantize_kv)
 
-    def body(x, k_pool, v_pool, w, l):
+    def body(x, pools, w, l):
+        k_pool, v_pool, k_s, v_s = pools
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)  # [B, *, 1, Hd]
         k_new = k[:, :, 0, :].transpose(1, 0, 2)  # [KH, B, Hd]
         v_new = v[:, :, 0, :].transpose(1, 0, 2)
+        if quantized:
+            k_new, k_sc = quantize_kv(k_new)  # int8 + [KH, B] scales
+            v_new, v_sc = quantize_kv(v_new)
+            k_s = k_s.at[l, kh_idx, page_idx[None, :], offset[None, :]].set(k_sc)
+            v_s = v_s.at[l, kh_idx, page_idx[None, :], offset[None, :]].set(v_sc)
         k_pool = k_pool.at[l, kh_idx, page_idx[None, :], offset[None, :], :].set(
             k_new.astype(k_pool.dtype))
         v_pool = v_pool.at[l, kh_idx, page_idx[None, :], offset[None, :], :].set(
             v_new.astype(v_pool.dtype))
         out = paged_attention_dispatch(
             q[:, :, 0, :], k_pool[l], v_pool[l], page_tables, lengths,
+            k_scales=k_s[l] if quantized else None,
+            v_scales=v_s[l] if quantized else None,
             use_pallas=use_pallas, mesh=mesh)
         x = _finish_block(cfg, x, out[:, :, None, :], w)
-        return x, k_pool, v_pool
+        return x, (k_pool, v_pool, k_s, v_s)
 
-    k_pool, v_pool = pool.k, pool.v
+    pools = (pool.k, pool.v, pool.k_s, pool.v_s)
     if _UNROLL_DECODE:
         from generativeaiexamples_tpu.ops.quant import QuantizedTensor
 
@@ -228,17 +259,18 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
 
         for l in range(cfg.n_layers):
             w = {k2: take(v2, l) for k2, v2 in params["layers"].items()}
-            x, k_pool, v_pool = body(x, k_pool, v_pool, w, l)
+            x, pools = body(x, pools, w, l)
     else:
         def scan_body(carry, wl):
-            x, k_pool, v_pool = carry
+            x, pools = carry
             w, l = wl
-            return body(x, k_pool, v_pool, w, l), None
+            return body(x, pools, w, l), None
 
-        (x, k_pool, v_pool), _ = jax.lax.scan(
-            scan_body, (x, k_pool, v_pool),
+        (x, pools), _ = jax.lax.scan(
+            scan_body, (x, pools),
             (params["layers"], jnp.arange(cfg.n_layers)))
-    return _logits(cfg, params, x)[:, 0], PagePool(k_pool, v_pool, ps)
+    k_pool, v_pool, k_s, v_s = pools
+    return _logits(cfg, params, x)[:, 0], PagePool(k_pool, v_pool, ps, k_s, v_s)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),
@@ -371,6 +403,4 @@ def cache_to_pool(
     kw = cache.k[:, 0].reshape(L, KH, npages, ps, Hd).transpose(0, 2, 1, 3, 4)
     vw = cache.v[:, 0].reshape(L, KH, npages, ps, Hd).transpose(0, 2, 1, 3, 4)
     li = jnp.arange(L)[:, None]
-    k = pool.k.at[li, :, table_row[None, :]].set(kw.astype(pool.k.dtype))
-    v = pool.v.at[li, :, table_row[None, :]].set(vw.astype(pool.v.dtype))
-    return PagePool(k, v, ps)
+    return _write_prefill_pages(pool, kw, vw, li, table_row[None, :])

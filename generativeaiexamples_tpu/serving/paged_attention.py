@@ -228,17 +228,46 @@ def _paged_tpu(q, k_pages, v_pages, page_table, lengths, *, scale,
                            scale=scale, interpret=interpret)
 
 
+def _paged_tpu_int8(q, k_pages, k_scales, v_pages, v_scales, page_table,
+                    lengths, *, scale, pages_per_compute_block):
+    from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+        paged_attention_int8, paged_attention_int8_reference)
+
+    ps, Hd = k_pages.shape[2], k_pages.shape[3]
+    # Mosaic DMA slices must be 128-lane aligned: the kernel needs
+    # page_size % 128 == 0 (scale pages are (1, ps) f32 tiles) and
+    # head_dim % 128 == 0. int8 serving configs use page_size=128.
+    if ps % 128 == 0 and Hd % 128 == 0:
+        return paged_attention_int8(
+            q, k_pages, k_scales, v_pages, v_scales, page_table, lengths,
+            scale=scale, pages_per_compute_block=pages_per_compute_block)
+    return paged_attention_int8_reference(
+        q, k_pages, k_scales, v_pages, v_scales, page_table, lengths,
+        scale=scale)
+
+
 def paged_attention_dispatch(
     q, k_pages, v_pages, page_table, lengths, *, scale=None,
+    k_scales=None, v_scales=None,
     use_pallas: Optional[bool] = None, mesh=None, interpret: bool = False,
     pages_per_compute_block: Optional[int] = None,
 ):
     """Pick the fastest available implementation for the current
     backend/mesh. `lengths` INCLUDES the current token, whose k/v must
-    already be written to the pool (write-then-attend decode)."""
+    already be written to the pool (write-then-attend decode). With
+    k_scales/v_scales the pool is int8 (narrow per-token scales) and
+    routes to the int8 kernel / its dequant oracle."""
+    quantized = k_scales is not None
     use_pallas = (jax.default_backend() == "tpu") if use_pallas is None \
         else use_pallas
     if not use_pallas or pltpu is None:
+        if quantized:
+            from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+                paged_attention_int8_reference)
+
+            return paged_attention_int8_reference(
+                q, k_pages, k_scales, v_pages, v_scales, page_table, lengths,
+                scale=scale)
         return paged_attention_reference(q, k_pages, v_pages, page_table,
                                          lengths, scale=scale)
     if mesh is not None and mesh.shape.get("tensor", 1) > 1:
@@ -247,6 +276,17 @@ def paged_attention_dispatch(
 
         hs = P(None, "tensor", None)
         pool_s = P("tensor", None, None, None)
+        if quantized:
+            scale_s = P("tensor", None, None)
+            fn = shard_map(
+                lambda q_, kp_, ks_, vp_, vs_, t_, ln_: _paged_tpu_int8(
+                    q_, kp_, ks_, vp_, vs_, t_, ln_, scale=scale,
+                    pages_per_compute_block=pages_per_compute_block),
+                mesh=mesh,
+                in_specs=(hs, pool_s, scale_s, pool_s, scale_s, P(), P()),
+                out_specs=hs, check_rep=False)
+            return fn(q, k_pages, k_scales, v_pages, v_scales, page_table,
+                      lengths)
         fn = shard_map(
             lambda q_, kp_, vp_, t_, ln_: _paged_tpu(
                 q_, kp_, vp_, t_, ln_, scale=scale, interpret=interpret,
@@ -254,6 +294,10 @@ def paged_attention_dispatch(
             mesh=mesh, in_specs=(hs, pool_s, pool_s, P(), P()),
             out_specs=hs, check_rep=False)
         return fn(q, k_pages, v_pages, page_table, lengths)
+    if quantized:
+        return _paged_tpu_int8(q, k_pages, k_scales, v_pages, v_scales,
+                               page_table, lengths, scale=scale,
+                               pages_per_compute_block=pages_per_compute_block)
     return _paged_tpu(q, k_pages, v_pages, page_table, lengths, scale=scale,
                       interpret=interpret,
                       pages_per_compute_block=pages_per_compute_block)

@@ -1,0 +1,255 @@
+"""Paged decode attention over an int8 KV pool with NARROW scales.
+
+Why this kernel exists (VERDICT r2 next-step #1b): bf16 KV caps the
+engine at B=64 on a 16 GB v5e (B=128 OOMs; docs/ENGINEERING_NOTES.md),
+and decode throughput is HBM-bandwidth-bound — weights are read once
+per step regardless of batch, so doubling the batch nearly doubles
+tokens/sec *if the KV pool fits and stays cheap to read*. int8 KV
+halves pool bytes. The stdlib JetStream-style kernel's quantized path
+is useless for this: it broadcasts f32 scales to head_dim width
+(5 B/token-elem effective vs bf16's 2) AND materializes the broadcast
+in HBM. Here scales are one f32 per (kv-head, token): 4 bytes next to
+the 128-byte int8 token row — 3% overhead instead of 200%.
+
+Layouts (per layer, matching kv_cache.PagePool):
+  q          [B, H, Hd]        softmax scale PRE-FOLDED by the caller
+  k_pages    [KH, P, ps, Hd]   int8
+  k_scales   [KH, P, ps]       f32  (amax/127 over Hd at write time)
+  page_table [B, maxp] int32   page ids (0 = garbage sink)
+  lengths    [B] int32         valid tokens INCLUDING the current one
+
+Kernel shape: grid (B, KH); the whole sequence loop for one (batch row,
+kv head) runs inside one grid step as a fori_loop over compute blocks
+of `pages_per_compute_block` pages. Pages stream HBM->VMEM through
+double-buffered async copies (the scale rows ride the same semaphore).
+Dequantization never touches head_dim: K scales multiply the score
+columns ((q @ k_q^T) * ks == q @ (k_q * ks)^T), V scales fold into the
+softmax weights before the PV matmul — the VPU work per block is
+O(G x bk), not O(bk x Hd).
+
+No reference-repo counterpart: the reference delegates KV management to
+TRT-LLM inside NIM (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def quantize_kv(x: jax.Array, scale_dtype=jnp.float32):
+    """Symmetric int8 over the last axis (head_dim): one scale per
+    (…, token) row. Returns (q int8, s scale_dtype[...-1])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.round(xf / s).clip(-127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(s, -1).astype(scale_dtype)
+
+
+def dequantize_pages(q_pages: jax.Array, scales: jax.Array,
+                     dtype=jnp.float32) -> jax.Array:
+    """[KH, P, ps, Hd] int8 + [KH, P, ps] -> float pages (CPU oracle)."""
+    return q_pages.astype(dtype) * scales.astype(dtype)[..., None]
+
+
+def paged_attention_int8_reference(q, k_pages, k_scales, v_pages, v_scales,
+                                   page_table, lengths, *, scale=None):
+    """Dequantize-then-attend oracle (any backend)."""
+    from generativeaiexamples_tpu.serving.paged_attention import (
+        paged_attention_reference)
+
+    k = dequantize_pages(k_pages, k_scales)
+    v = dequantize_pages(v_pages, v_scales)
+    return paged_attention_reference(q, k, v, page_table, lengths,
+                                     scale=scale).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _copy_block(pages_ref, hbm, buf, sem, b, i, slot, *, ppcb, maxp, h):
+    """Async copies for compute block i of row b into buffer `slot`.
+    Returns the copy descriptors (recreate-and-wait pattern: semaphores
+    count bytes, so identical descriptors built later can wait)."""
+    copies = []
+    for j in range(ppcb):
+        pid = pages_ref[b * maxp + i * ppcb + j]
+        copies.append(pltpu.make_async_copy(
+            hbm.at[h, pid], buf.at[slot, j], sem.at[slot]))
+    return copies
+
+
+def _int8_kernel(
+    lengths_ref,   # scalar prefetch [B]
+    tables_ref,    # scalar prefetch [B * maxp]
+    q_ref,         # [1, 1, G, Hd] f32 (scale pre-folded)
+    kq_hbm,        # [KH, P, ps, Hd] int8 (ANY)
+    ks_hbm,        # [KH, P, 1, ps] f32 (ANY)
+    vq_hbm,
+    vs_hbm,
+    o_ref,         # [1, 1, G, Hd]
+    kq_buf,        # VMEM [2, ppcb, ps, Hd] int8
+    ks_buf,        # VMEM [2, ppcb, 1, ps] f32
+    vq_buf,
+    vs_buf,
+    k_sem,         # DMA sems [2]
+    v_sem,
+    *,
+    ppcb: int,
+    maxp: int,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ps = page_size
+    bk = ppcb * ps
+    length = lengths_ref[b]
+    nblk = lax.div(length + bk - 1, bk)
+    G, Hd = q_ref.shape[2], q_ref.shape[3]
+
+    def copies(i, slot):
+        out = []
+        for hbm, buf, sem in ((kq_hbm, kq_buf, k_sem),
+                              (ks_hbm, ks_buf, k_sem),
+                              (vq_hbm, vq_buf, v_sem),
+                              (vs_hbm, vs_buf, v_sem)):
+            out.extend(_copy_block(tables_ref, hbm, buf, sem, b, i, slot,
+                                   ppcb=ppcb, maxp=maxp, h=h))
+        return out
+
+    def start(i, slot):
+        for c in copies(i, slot):
+            c.start()
+
+    def wait(i, slot):
+        for c in copies(i, slot):
+            c.wait()
+
+    start(0, 0)
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, Hd]
+
+    def body(i, carry):
+        slot = lax.rem(i, 2)
+
+        @pl.when(i + 1 < nblk)
+        def _prefetch():
+            start(i + 1, lax.rem(i + 1, 2))
+
+        wait(i, slot)
+        # Per-page online softmax (static unroll over ppcb): Mosaic has
+        # no layout for collapsing a (ppcb, ps) scale tile into score
+        # lanes, so scores are formed and rescaled one (G, ps) page at
+        # a time — all shapes stay 2-D, no relayouts.
+        carry_i = carry
+        for j in range(ppcb):
+            m_prev, l_prev, acc = carry_i
+            kq = kq_buf[slot, j].astype(jnp.float32)  # [ps, Hd]
+            ks = ks_buf[slot, j]                      # [1, ps]
+            vq = vq_buf[slot, j].astype(jnp.float32)
+            vs = vs_buf[slot, j]
+            s = jax.lax.dot_general(
+                q, kq, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * ks  # [G, ps]
+            pos = i * bk + j * ps + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos < length, s, NEG_INF)
+
+            m_curr = jnp.max(s, axis=1, keepdims=True)  # [G, 1]
+            m_new = jnp.maximum(m_prev, m_curr)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)  # padded cols: exp(NEG_INF - m) == 0
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p * vs, vq, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [G, Hd]
+            carry_i = (m_new, l_new, acc * alpha + pv)
+        return carry_i
+
+    init = (jnp.full((G, 1), NEG_INF, jnp.float32),
+            jnp.zeros((G, 1), jnp.float32),
+            jnp.zeros((G, Hd), jnp.float32))
+    m, l, acc = lax.fori_loop(0, nblk, body, init)
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / denom).astype(o_ref.dtype)
+
+
+def _pages_per_block(maxp: int, want: int) -> int:
+    for g in range(min(want, maxp), 0, -1):
+        if maxp % g == 0:
+            return g
+    return 1
+
+
+@functools.partial(jax.jit, static_argnames=("scale",
+                                             "pages_per_compute_block"))
+def paged_attention_int8(
+    q: jax.Array,          # [B, H, Hd]
+    k_pages: jax.Array,    # [KH, P, ps, Hd] int8
+    k_scales: jax.Array,   # [KH, P, ps] f32
+    v_pages: jax.Array,
+    v_scales: jax.Array,
+    page_table: jax.Array,  # [B, maxp] int32
+    lengths: jax.Array,     # [B] int32, incl. current token
+    *,
+    scale: float | None = None,
+    pages_per_compute_block: int | None = None,
+) -> jax.Array:
+    if pltpu is None:
+        raise RuntimeError("Pallas TPU unavailable; use the reference path")
+    B, H, Hd = q.shape
+    KH, P, ps, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    G = H // KH
+    s = scale if scale is not None else Hd ** -0.5
+    ppcb = _pages_per_block(maxp, pages_per_compute_block or 8)
+
+    qk = (q.astype(jnp.float32) * s).reshape(B, KH, G, Hd)
+    # Scale pages as 2-D [1, ps] tiles (metadata-only reshape): the
+    # kernel DMAs and consumes them without any vector relayout.
+    ks2 = k_scales.reshape(KH, P, 1, ps)
+    vs2 = v_scales.reshape(KH, P, 1, ps)
+
+    kernel = functools.partial(_int8_kernel, ppcb=ppcb, maxp=maxp,
+                               page_size=ps)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KH),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, Hd), lambda b, h, L, T: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, Hd), lambda b, h, L, T: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ppcb, ps, Hd), jnp.int8),
+            pltpu.VMEM((2, ppcb, 1, ps), jnp.float32),
+            pltpu.VMEM((2, ppcb, ps, Hd), jnp.int8),
+            pltpu.VMEM((2, ppcb, 1, ps), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, G, Hd), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
+      qk, k_pages, ks2, v_pages, vs2)
+    return out.reshape(B, H, Hd).astype(q.dtype)
