@@ -145,6 +145,22 @@ class VLMConfig:
 
 
 @dataclass(frozen=True)
+class VoiceConfig:
+    """ASR/TTS endpoints for the playground's voice path (the reference
+    streams mic audio to Riva ASR and replies through Riva TTS —
+    frontend/asr_utils.py:42-152, tts_utils.py:37-127). Any
+    OpenAI-audio-compatible endpoint works (streaming/asr.py clients);
+    empty URLs disable the voice buttons (the UI stays text-only)."""
+
+    asr_server_url: str = ""
+    asr_model: str = "whisper-1"
+    tts_server_url: str = ""
+    tts_model: str = "tts-1"
+    tts_voice: str = "alloy"
+    sample_rate: int = 16000
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout — the TPU-native replacement for the reference's
     single multi-GPU knob (INFERENCE_GPU_COUNT, compose.env:17-18).
@@ -207,6 +223,7 @@ class AppConfig:
     reranker: RerankerConfig = field(default_factory=RerankerConfig)
     retriever: RetrieverConfig = field(default_factory=RetrieverConfig)
     vlm: VLMConfig = field(default_factory=VLMConfig)
+    voice: VoiceConfig = field(default_factory=VoiceConfig)
     prompts: PromptsConfig = field(default_factory=PromptsConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)

@@ -100,7 +100,7 @@ def _head_ce(params, cfg: llama.LlamaConfig, x, targets, mask):
 
 
 def pipeline_loss(params, cfg: llama.LlamaConfig, tokens, targets, mask, *,
-                  mesh: Mesh, n_micro: int, rules: dict = LLM_RULES):
+                  mesh: Mesh, n_micro: int):
     """Masked-mean next-token CE computed through the GPipe schedule.
     Numerically equals trainer.loss_fn (same math, different schedule —
     tests assert loss AND grads match the non-pipelined step)."""
@@ -149,12 +149,12 @@ def pipeline_loss(params, cfg: llama.LlamaConfig, tokens, targets, mask, *,
 
 
 def make_pp_train_step(cfg: llama.LlamaConfig, tcfg, optimizer, *,
-                       mesh: Mesh, n_micro: int, rules: dict = LLM_RULES):
+                       mesh: Mesh, n_micro: int):
     """Pipelined twin of trainer.make_train_step: (params, opt_state,
     batch) -> (params, opt_state, metrics)."""
 
     def step(params, opt_state, batch):
-        lf = partial(pipeline_loss, mesh=mesh, n_micro=n_micro, rules=rules)
+        lf = partial(pipeline_loss, mesh=mesh, n_micro=n_micro)
         if tcfg.remat:
             lf = jax.checkpoint(lf, static_argnums=(1,))
         loss, grads = jax.value_and_grad(lf)(

@@ -36,9 +36,10 @@ class Resources:
             config, dim=dim, mesh=mesh,
             persist_dir=config.vector_store.persist_dir)
         # second store for conversation memory (multi_turn_rag parity,
-        # chains.py:45-58 `conv_store`)
+        # chains.py:45-58 `conv_store`) — ephemeral: stays in-process
+        # even when the document store is an external DB.
         self.conv_store = conv_store if conv_store is not None else \
-            create_vector_store(config, dim=dim, mesh=mesh)
+            create_vector_store(config, dim=dim, mesh=mesh, ephemeral=True)
         self.splitter = get_text_splitter(config)
         self.retriever = Retriever(
             self.store, self.embedder,

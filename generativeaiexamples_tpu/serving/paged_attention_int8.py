@@ -81,29 +81,33 @@ def paged_attention_int8_reference(q, k_pages, k_scales, v_pages, v_scales,
 # ---------------------------------------------------------------------------
 
 
-def _copy_block(pages_ref, hbm, buf, sem, b, i, slot, *, ppcb, maxp, h):
-    """Async copies for compute block i of row b into buffer `slot`.
-    Returns the copy descriptors (recreate-and-wait pattern: semaphores
-    count bytes, so identical descriptors built later can wait)."""
+def _copy_block(pages_ref, hbm, buf, sem, b, i, slot, *, ppcb, maxp):
+    """Async copies for compute block i of row b into buffer `slot`:
+    one STRIDED descriptor per page covering ALL kv heads
+    (hbm.at[:, pid] on the [KH, P, ...] pool). Returns the descriptors
+    (recreate-and-wait pattern: semaphores count bytes, so identical
+    descriptors built later can wait)."""
     copies = []
     for j in range(ppcb):
         pid = pages_ref[b * maxp + i * ppcb + j]
         copies.append(pltpu.make_async_copy(
-            hbm.at[h, pid], buf.at[slot, j], sem.at[slot]))
+            hbm.at[:, pid], buf.at[slot, j], sem.at[slot]))
     return copies
 
 
 def _int8_kernel(
     lengths_ref,   # scalar prefetch [B]
     tables_ref,    # scalar prefetch [B * maxp]
-    q_ref,         # [1, 1, G, Hd] f32 (scale pre-folded)
+    buf_idx_ref,   # scalar prefetch [1] — persists ACROSS grid steps
+    init_ref,      # scalar prefetch [1] — 1 on the very first grid step
+    q_ref,         # [1, KH, G, Hd] f32 (scale pre-folded)
     kq_hbm,        # [KH, P, ps, Hd] int8 (ANY)
     ks_hbm,        # [KH, P, 1, ps] f32 (ANY)
     vq_hbm,
     vs_hbm,
-    o_ref,         # [1, 1, G, Hd]
-    kq_buf,        # VMEM [2, ppcb, ps, Hd] int8
-    ks_buf,        # VMEM [2, ppcb, 1, ps] f32
+    o_ref,         # [1, KH, G, Hd]
+    kq_buf,        # VMEM [2, ppcb, KH, ps, Hd] int8
+    ks_buf,        # VMEM [2, ppcb, KH, 1, ps] f32
     vq_buf,
     vs_buf,
     k_sem,         # DMA sems [2]
@@ -112,78 +116,102 @@ def _int8_kernel(
     ppcb: int,
     maxp: int,
     page_size: int,
+    batch_size: int,
 ):
+    """One grid step per BATCH ROW, all kv heads together.
+
+    Two design rules, both measured on a v5e through the decode path
+    (scripts/decompose_decode.py: attention was 35 of 73 ms/iteration
+    at B=128 before them):
+
+    1. DMA-issue count is the floor. A (B, KH) grid issues
+       B x KH x pages x 4 copies per layer (12k at B=128); one grid
+       step per row with per-page descriptors STRIDED across the KH
+       axis cuts that 8x — the DMA engine walks the head stride, the
+       scalar core issues once.
+    2. Latency hiding is CROSS-grid-step (the JetStream scheme): while
+       row b's block computes, the next block's copies are already in
+       flight in the other buffer; buf_idx/init persist in SMEM across
+       grid steps."""
     b = pl.program_id(0)
-    h = pl.program_id(1)
     ps = page_size
     bk = ppcb * ps
     length = lengths_ref[b]
     nblk = lax.div(length + bk - 1, bk)
-    G, Hd = q_ref.shape[2], q_ref.shape[3]
+    KH, G, Hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
 
-    def copies(i, slot):
+    def copies(bb, i, slot):
         out = []
         for hbm, buf, sem in ((kq_hbm, kq_buf, k_sem),
                               (ks_hbm, ks_buf, k_sem),
                               (vq_hbm, vq_buf, v_sem),
                               (vs_hbm, vs_buf, v_sem)):
-            out.extend(_copy_block(tables_ref, hbm, buf, sem, b, i, slot,
-                                   ppcb=ppcb, maxp=maxp, h=h))
+            out.extend(_copy_block(tables_ref, hbm, buf, sem, bb, i, slot,
+                                   ppcb=ppcb, maxp=maxp))
         return out
 
-    def start(i, slot):
-        for c in copies(i, slot):
+    def next_block(i):
+        """Block after (b, i-1): block i of this row if still inside
+        the sequence, else the next row's first block (lengths >= 1, so
+        every row has at least one block)."""
+        return lax.cond(i * bk < length,
+                        lambda: (b, i),
+                        lambda: (b + 1, jnp.int32(0)))
+
+    @pl.when(init_ref[0] == 1)
+    def _first():
+        init_ref[0] = 0
+        for c in copies(b, 0, buf_idx_ref[0]):
             c.start()
 
-    def wait(i, slot):
-        for c in copies(i, slot):
-            c.wait()
-
-    start(0, 0)
-    q = q_ref[0, 0].astype(jnp.float32)  # [G, Hd]
+    q = q_ref[0].astype(jnp.float32)  # [KH, G, Hd]
 
     def body(i, carry):
-        slot = lax.rem(i, 2)
+        slot = buf_idx_ref[0]
+        nxt_b, nxt_i = next_block(i + 1)
 
-        @pl.when(i + 1 < nblk)
+        @pl.when(nxt_b < batch_size)
         def _prefetch():
-            start(i + 1, lax.rem(i + 1, 2))
+            nslot = 1 - slot
+            for c in copies(nxt_b, nxt_i, nslot):
+                c.start()
+            buf_idx_ref[0] = nslot
 
-        wait(i, slot)
-        # Per-page online softmax (static unroll over ppcb): Mosaic has
-        # no layout for collapsing a (ppcb, ps) scale tile into score
-        # lanes, so scores are formed and rescaled one (G, ps) page at
-        # a time — all shapes stay 2-D, no relayouts.
+        for c in copies(b, i, slot):
+            c.wait()
+        # Per-page online softmax (static unroll over ppcb), all kv
+        # heads batched: shapes stay <= 3-D with the head axis leading —
+        # no Mosaic relayouts, and each dot is KH x (G x ps x Hd).
         carry_i = carry
         for j in range(ppcb):
             m_prev, l_prev, acc = carry_i
-            kq = kq_buf[slot, j].astype(jnp.float32)  # [ps, Hd]
-            ks = ks_buf[slot, j]                      # [1, ps]
+            kq = kq_buf[slot, j].astype(jnp.float32)  # [KH, ps, Hd]
+            ks = ks_buf[slot, j]                      # [KH, 1, ps]
             vq = vq_buf[slot, j].astype(jnp.float32)
             vs = vs_buf[slot, j]
             s = jax.lax.dot_general(
-                q, kq, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * ks  # [G, ps]
-            pos = i * bk + j * ps + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                q, kq, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * ks  # [KH, G, ps]
+            pos = i * bk + j * ps + lax.broadcasted_iota(jnp.int32, s.shape, 2)
             s = jnp.where(pos < length, s, NEG_INF)
 
-            m_curr = jnp.max(s, axis=1, keepdims=True)  # [G, 1]
+            m_curr = jnp.max(s, axis=2, keepdims=True)  # [KH, G, 1]
             m_new = jnp.maximum(m_prev, m_curr)
             alpha = jnp.exp(m_prev - m_new)
             p = jnp.exp(s - m_new)  # padded cols: exp(NEG_INF - m) == 0
-            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            l_new = alpha * l_prev + jnp.sum(p, axis=2, keepdims=True)
             pv = jax.lax.dot_general(
-                p * vs, vq, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [G, Hd]
+                p * vs, vq, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)  # [KH, G, Hd]
             carry_i = (m_new, l_new, acc * alpha + pv)
         return carry_i
 
-    init = (jnp.full((G, 1), NEG_INF, jnp.float32),
-            jnp.zeros((G, 1), jnp.float32),
-            jnp.zeros((G, Hd), jnp.float32))
+    init = (jnp.full((KH, G, 1), NEG_INF, jnp.float32),
+            jnp.zeros((KH, G, 1), jnp.float32),
+            jnp.zeros((KH, G, Hd), jnp.float32))
     m, l, acc = lax.fori_loop(0, nblk, body, init)
     denom = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / denom).astype(o_ref.dtype)
+    o_ref[0] = (acc / denom).astype(o_ref.dtype)
 
 
 def _pages_per_block(maxp: int, want: int) -> int:
@@ -223,23 +251,24 @@ def paged_attention_int8(
     vs2 = v_scales.reshape(KH, P, 1, ps)
 
     kernel = functools.partial(_int8_kernel, ppcb=ppcb, maxp=maxp,
-                               page_size=ps)
+                               page_size=ps, batch_size=B)
+    qmap = lambda b, L, T, BI, IF: (b, 0, 0, 0)  # noqa: E731
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KH),
+        num_scalar_prefetch=4,
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, 1, G, Hd), lambda b, h, L, T: (b, h, 0, 0)),
+            pl.BlockSpec((1, KH, G, Hd), qmap),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, Hd), lambda b, h, L, T: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, KH, G, Hd), qmap),
         scratch_shapes=[
-            pltpu.VMEM((2, ppcb, ps, Hd), jnp.int8),
-            pltpu.VMEM((2, ppcb, 1, ps), jnp.float32),
-            pltpu.VMEM((2, ppcb, ps, Hd), jnp.int8),
-            pltpu.VMEM((2, ppcb, 1, ps), jnp.float32),
+            pltpu.VMEM((2, ppcb, KH, ps, Hd), jnp.int8),
+            pltpu.VMEM((2, ppcb, KH, 1, ps), jnp.float32),
+            pltpu.VMEM((2, ppcb, KH, ps, Hd), jnp.int8),
+            pltpu.VMEM((2, ppcb, KH, 1, ps), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
@@ -248,8 +277,11 @@ def paged_attention_int8(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KH, G, Hd), jnp.float32),
+        # Sequential grid: the prefetch buffer index threads through SMEM
+        # from one grid step to the next.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("arbitrary",)),
     )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
+      jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
       qk, k_pages, ks2, v_pages, vs2)
     return out.reshape(B, H, Hd).astype(q.dtype)

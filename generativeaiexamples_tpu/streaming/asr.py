@@ -40,6 +40,35 @@ def pcm_to_wav_bytes(pcm: np.ndarray, sample_rate: int) -> bytes:
     return buf.getvalue()
 
 
+def wav_bytes_to_pcm(data: bytes) -> "tuple[np.ndarray, int]":
+    """WAV bytes -> (mono int16 PCM, sample_rate). Multi-channel input
+    is averaged to mono (browser recorders often emit stereo)."""
+    with wave.open(io.BytesIO(data), "rb") as w:
+        rate = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        frames = w.readframes(w.getnframes())
+    if width != 2:
+        raise ValueError(f"expected 16-bit PCM WAV, got {8 * width}-bit")
+    pcm = np.frombuffer(frames, np.int16)
+    if nch > 1:
+        pcm = pcm.reshape(-1, nch).mean(axis=1).astype(np.int16)
+    return pcm, rate
+
+
+def create_voice_clients(cfg):
+    """(asr, tts) from AppConfig.voice — HTTP clients when URLs are
+    configured, None otherwise (UI hides the voice controls)."""
+    voice = getattr(cfg, "voice", None)
+    if voice is None:
+        return None, None
+    asr = HTTPASRClient(voice.asr_server_url, voice.asr_model) \
+        if voice.asr_server_url else None
+    tts = HTTPTTSClient(voice.tts_server_url, voice.tts_model,
+                        voice.tts_voice) if voice.tts_server_url else None
+    return asr, tts
+
+
 class HTTPASRClient:
     """POSTs WAV chunks to an OpenAI-compatible /v1/audio/transcriptions
     endpoint (the Riva-replacement seam; any Whisper server works)."""

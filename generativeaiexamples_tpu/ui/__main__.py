@@ -37,7 +37,15 @@ def main() -> None:
     cfg = load_config(args.config)
     tracing.setup(cfg)
     client = ChatClient(args.chain_server, args.model_name)
-    server = PlaygroundServer(client)
+    from generativeaiexamples_tpu.streaming.asr import create_voice_clients
+
+    asr, tts = create_voice_clients(cfg)
+    if asr or tts:
+        logging.info("voice: asr=%s tts=%s", bool(asr), bool(tts))
+    voice_cfg = getattr(cfg, "voice", None)
+    server = PlaygroundServer(
+        client, asr=asr, tts=tts,
+        voice_sample_rate=voice_cfg.sample_rate if voice_cfg else 16000)
     logging.info("playground on %s:%d -> chain server %s",
                  args.host, args.port, args.chain_server)
     run_server(server, args.host, args.port)

@@ -25,10 +25,20 @@ STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
 
 
 class PlaygroundServer:
-    """aiohttp app wrapping a ChatClient (reference APIServer)."""
+    """aiohttp app wrapping a ChatClient (reference APIServer).
 
-    def __init__(self, client: ChatClient) -> None:
+    With `asr`/`tts` clients (streaming/asr.py protocols) the voice
+    path is live: the mic button posts WAV to /api/transcribe and
+    replies can be spoken via /api/speech — the Riva round-trip of the
+    reference frontend (frontend/asr_utils.py:42-152,
+    tts_utils.py:77-127) behind pluggable endpoints."""
+
+    def __init__(self, client: ChatClient, asr=None, tts=None,
+                 voice_sample_rate: int = 16000) -> None:
         self.client = client
+        self.asr = asr
+        self.tts = tts
+        self.voice_sample_rate = voice_sample_rate
         self.app = web.Application(client_max_size=100 * 1024 * 1024)
         self.app.add_routes([
             web.get("/", self.page_converse),
@@ -40,6 +50,9 @@ class PlaygroundServer:
             web.get("/api/documents", self.handle_list),
             web.post("/api/documents", self.handle_upload),
             web.delete("/api/documents", self.handle_delete),
+            web.get("/api/voice", self.handle_voice_caps),
+            web.post("/api/transcribe", self.handle_transcribe),
+            web.post("/api/speech", self.handle_speech),
         ])
         self.app.router.add_static("/static", STATIC_DIR)
 
@@ -99,7 +112,11 @@ class PlaygroundServer:
         return web.json_response({"documents": docs})
 
     async def handle_upload(self, request: web.Request) -> web.Response:
-        reader = await request.multipart()
+        try:
+            reader = await request.multipart()
+        except (AssertionError, ValueError):
+            return web.json_response({"detail": "multipart form required"},
+                                     status=422)
         field = await reader.next()
         while field is not None and field.name != "file":
             field = await reader.next()
@@ -129,6 +146,53 @@ class PlaygroundServer:
         out = await asyncio.to_thread(self.client.delete_documents, fname)
         return web.json_response(out if isinstance(out, dict)
                                  else {"message": str(out)})
+
+    # -- voice (reference: Riva ASR/TTS in the frontend) -------------------
+
+    async def handle_voice_caps(self, request: web.Request) -> web.Response:
+        """The page probes this to decide whether to show the mic /
+        speaker controls."""
+        return web.json_response({"asr": self.asr is not None,
+                                  "tts": self.tts is not None})
+
+    async def handle_transcribe(self, request: web.Request) -> web.Response:
+        """WAV body (audio/wav) -> {"text": transcript}."""
+        if self.asr is None:
+            return web.json_response(
+                {"detail": "no ASR endpoint configured "
+                           "(set APP_VOICE_ASRSERVERURL)"}, status=501)
+        from generativeaiexamples_tpu.streaming.asr import wav_bytes_to_pcm
+
+        data = await request.read()
+        try:
+            pcm, rate = wav_bytes_to_pcm(data)
+        except Exception as e:
+            return web.json_response({"detail": f"bad WAV payload: {e}"},
+                                     status=422)
+        text = await asyncio.to_thread(self.asr.transcribe, pcm, rate)
+        return web.json_response({"text": text})
+
+    async def handle_speech(self, request: web.Request) -> web.Response:
+        """{"text": ...} -> WAV bytes (audio/wav)."""
+        if self.tts is None:
+            return web.json_response(
+                {"detail": "no TTS endpoint configured "
+                           "(set APP_VOICE_TTSSERVERURL)"}, status=501)
+        from generativeaiexamples_tpu.streaming.asr import pcm_to_wav_bytes
+
+        try:
+            body = await request.json()
+            text = (body.get("text") or "").strip()
+            rate = int(body.get("sample_rate", self.voice_sample_rate))
+        except (json.JSONDecodeError, AttributeError, TypeError, ValueError):
+            return web.json_response({"detail": "expected JSON object with "
+                                                "text and optional numeric "
+                                                "sample_rate"}, status=422)
+        if not text:
+            return web.json_response({"detail": "text required"}, status=422)
+        pcm = await asyncio.to_thread(self.tts.synthesize, text, rate)
+        return web.Response(body=pcm_to_wav_bytes(pcm, rate),
+                            content_type="audio/wav")
 
 
 def run_server(server: PlaygroundServer, host: str, port: int) -> None:

@@ -76,5 +76,89 @@ form.addEventListener("submit", async (ev) => {
   } finally {
     sendBtn.disabled = false;
     input.focus();
+    if (speakBox && speakBox.checked && bot.textContent) speak(bot.textContent);
   }
 });
+
+// --- voice path (reference: Riva ASR/TTS in the frontend;
+// asr_utils.py start_recording / tts_utils.py text_to_speech) ---------
+const micBtn = document.getElementById("mic");
+const speakWrap = document.getElementById("speak-wrap");
+const speakBox = document.getElementById("speak");
+
+fetch("/api/voice").then((r) => r.json()).then((caps) => {
+  if (caps.asr && navigator.mediaDevices) micBtn.hidden = false;
+  if (caps.tts) speakWrap.hidden = false;
+}).catch(() => {});
+
+function pcm16Wav(samples, rate) {
+  // Float32 [-1,1] -> 16-bit mono WAV blob (no MediaRecorder codecs:
+  // the server wants plain PCM it can hand any ASR endpoint).
+  const buf = new ArrayBuffer(44 + samples.length * 2);
+  const v = new DataView(buf);
+  const str = (o, s) => { for (let i = 0; i < s.length; i++) v.setUint8(o + i, s.charCodeAt(i)); };
+  str(0, "RIFF"); v.setUint32(4, 36 + samples.length * 2, true);
+  str(8, "WAVE"); str(12, "fmt "); v.setUint32(16, 16, true);
+  v.setUint16(20, 1, true); v.setUint16(22, 1, true);
+  v.setUint32(24, rate, true); v.setUint32(28, rate * 2, true);
+  v.setUint16(32, 2, true); v.setUint16(34, 16, true);
+  str(36, "data"); v.setUint32(40, samples.length * 2, true);
+  for (let i = 0; i < samples.length; i++) {
+    const s = Math.max(-1, Math.min(1, samples[i]));
+    v.setInt16(44 + i * 2, s < 0 ? s * 0x8000 : s * 0x7fff, true);
+  }
+  return new Blob([buf], { type: "audio/wav" });
+}
+
+let rec = null;
+async function startRec() {
+  const stream = await navigator.mediaDevices.getUserMedia({ audio: true });
+  const ctx = new AudioContext();
+  const src = ctx.createMediaStreamSource(stream);
+  const proc = ctx.createScriptProcessor(4096, 1, 1);
+  const chunks = [];
+  proc.onaudioprocess = (e) => chunks.push(new Float32Array(e.inputBuffer.getChannelData(0)));
+  src.connect(proc); proc.connect(ctx.destination);
+  rec = { stream, ctx, proc, chunks };
+  micBtn.classList.add("recording");
+}
+
+async function stopRec() {
+  if (!rec) return;
+  const { stream, ctx, proc, chunks } = rec;
+  rec = null;
+  micBtn.classList.remove("recording");
+  proc.disconnect(); stream.getTracks().forEach((t) => t.stop());
+  const rate = ctx.sampleRate; await ctx.close();
+  const n = chunks.reduce((a, c) => a + c.length, 0);
+  const all = new Float32Array(n);
+  let o = 0; for (const c of chunks) { all.set(c, o); o += c.length; }
+  const resp = await fetch("/api/transcribe", {
+    method: "POST", headers: { "Content-Type": "audio/wav" },
+    body: pcm16Wav(all, rate),
+  });
+  if (resp.ok) {
+    const out = await resp.json();
+    if (out.text) { input.value = out.text; form.requestSubmit(); }
+  }
+}
+
+// Pointer events cover mouse AND touch (hold-to-talk on phones).
+micBtn.addEventListener("pointerdown", (e) => { e.preventDefault(); startRec(); });
+micBtn.addEventListener("pointerup", stopRec);
+micBtn.addEventListener("pointercancel", () => rec && stopRec());
+micBtn.addEventListener("pointerleave", () => rec && stopRec());
+
+async function speak(text) {
+  try {
+    const resp = await fetch("/api/speech", {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ text: text }),
+    });
+    if (!resp.ok) return;
+    const url = URL.createObjectURL(await resp.blob());
+    const audio = new Audio(url);
+    audio.onended = () => URL.revokeObjectURL(url);
+    audio.play();
+  } catch (e) { /* voice is best-effort */ }
+}

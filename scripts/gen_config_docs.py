@@ -1,0 +1,103 @@
+"""Generate docs/configuration.md from the config schema.
+
+The reference hand-maintains docs/configuration.md against
+common/configuration.py; here the page is generated from the dataclass
+tree itself (sections, fields, defaults, env-var names, section
+docstrings), so it cannot drift. Run:
+
+    python scripts/gen_config_docs.py          # writes docs/configuration.md
+    python scripts/gen_config_docs.py --check  # CI drift check
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from generativeaiexamples_tpu.config import schema  # noqa: E402
+
+HEADER = """# Configuration reference
+
+<!-- GENERATED FILE — edit config/schema.py and re-run
+     `python scripts/gen_config_docs.py`. -->
+
+The framework is configured the same way the reference is
+(`common/configuration_wizard.py`): a YAML/JSON file merged with
+`APP_<SECTION>_<FIELD>` environment variables (env wins; values are
+JSON-parsed when possible). Load order: `APP_CONFIG_FILE` (or
+`--config`) -> env overlay -> frozen dataclass tree
+(`config/wizard.py:load_config`).
+
+Example:
+
+```yaml
+llm:
+  model_name: llama3-8b
+vector_store:
+  name: tpu
+engine:
+  max_batch_size: 64
+  kv_dtype: int8
+```
+
+```sh
+APP_LLM_MODELNAME=llama3-8b APP_ENGINE_MAXBATCHSIZE=64 \\
+  python -m generativeaiexamples_tpu.api --example developer_rag
+```
+"""
+
+
+def _fmt_default(v) -> str:
+    if dataclasses.is_dataclass(v):
+        return "(section)"
+    if isinstance(v, str):
+        return f'`"{v}"`' if v else "`\"\"`"
+    return f"`{v!r}`"
+
+
+def render() -> str:
+    out = [HEADER]
+    root = schema.AppConfig()
+    for f in dataclasses.fields(root):
+        section = f.name
+        node = getattr(root, section)
+        cls = type(node)
+        doc = inspect.getdoc(cls) or ""
+        out.append(f"\n## `{section}`\n")
+        if doc:
+            out.append(doc + "\n")
+        out.append("| field | default | env var |")
+        out.append("|---|---|---|")
+        for sf in dataclasses.fields(cls):
+            default = getattr(node, sf.name)
+            env = schema.env_var_name(section, sf.name)
+            comment = ""
+            out.append(f"| `{sf.name}` | {_fmt_default(default)} | "
+                       f"`{env}`{comment} |")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def main() -> None:
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "configuration.md")
+    text = render()
+    if "--check" in sys.argv:
+        with open(path) as fh:
+            if fh.read() != text:
+                raise SystemExit(
+                    "docs/configuration.md is stale — run "
+                    "python scripts/gen_config_docs.py")
+        print("configuration.md up to date")
+        return
+    with open(path, "w") as fh:
+        fh.write(text)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

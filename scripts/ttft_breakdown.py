@@ -1,0 +1,114 @@
+"""TTFT stage breakdown on real TPU (VERDICT r2 next-step #2: hit
+<=200 ms p50 or publish a measured per-stage table).
+
+Boots the deployment-config engine (llama3-8b int8 weights, int8 KV,
+B=128), warms it, then timestamps one request's path through the
+scheduler: submit -> admit (scheduler picks it up) -> prefill dispatch
+returns (async) -> first decode block dispatch returns (async) ->
+host fetch of that block starts/ends -> token emitted. The fetch
+segment is the host<->device readback (~100 ms through the axon
+tunnel; near-zero on direct-attached hosts).
+
+Usage: python scripts/ttft_breakdown.py [n_requests]
+Prints one stage table per request plus the median summary row for
+docs/ENGINEERING_NOTES.md.
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+from generativeaiexamples_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from generativeaiexamples_tpu.config.schema import EngineConfig  # noqa: E402
+from generativeaiexamples_tpu.models import llama  # noqa: E402
+from generativeaiexamples_tpu.serving.engine import LLMEngine  # noqa: E402
+from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer  # noqa: E402
+
+
+def main() -> None:
+    sys.path.insert(0, "/root/repo")
+    from scripts.bench_params import build_params_on_device
+
+    n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 9
+    cfg = llama.LlamaConfig.llama3_8b()
+    params = build_params_on_device(cfg, quantize=True)
+    jax.block_until_ready(params["layers"]["wq"].q)
+    ecfg = EngineConfig(max_batch_size=128, max_seq_len=384, page_size=128,
+                        prefill_buckets=(128,), kv_dtype="int8",
+                        decode_steps_per_dispatch=8, pipeline_depth=2)
+    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg)
+    eng.warmup()
+    eng.start()
+    prompt = list(range(2, 130))
+    list(eng.generate_stream(prompt, max_new_tokens=4))  # e2e warm
+    print("[ttft] engine warm", file=sys.stderr)
+
+    marks = {}
+
+    orig_prefill = eng._prefill_group
+    orig_dispatch = eng._dispatch_decode
+    orig_process = eng._process_block_inner
+
+    def prefill_group(bucket, entries):
+        marks.setdefault("admit", time.perf_counter())
+        out = orig_prefill(bucket, entries)
+        marks.setdefault("prefill_dispatched", time.perf_counter())
+        return out
+
+    def dispatch_decode():
+        out = orig_dispatch()
+        if "prefill_dispatched" in marks:
+            marks.setdefault("decode_dispatched", time.perf_counter())
+        return out
+
+    def process_block(fl):
+        if "decode_dispatched" in marks:
+            marks.setdefault("fetch_start", time.perf_counter())
+        out = orig_process(fl)
+        if "fetch_start" in marks:
+            marks.setdefault("fetch_end", time.perf_counter())
+        return out
+
+    eng._prefill_group = prefill_group
+    eng._dispatch_decode = dispatch_decode
+    eng._process_block_inner = process_block
+
+    stages = ["admit", "prefill_dispatched", "decode_dispatched",
+              "fetch_start", "fetch_end", "first_token"]
+    rows = []
+    for r in range(n_req):
+        marks.clear()
+        t0 = time.perf_counter()
+        for ev in eng.generate_stream(prompt, max_new_tokens=2):
+            if ev["token_id"] >= 0:
+                marks.setdefault("first_token", time.perf_counter())
+                break
+        row = {}
+        prev = t0
+        for s in stages:
+            if s in marks:
+                row[s] = (marks[s] - prev) * 1e3
+                prev = marks[s]
+        row["total"] = (marks.get("first_token", prev) - t0) * 1e3
+        rows.append(row)
+        print(f"[ttft] req {r}: " + "  ".join(
+            f"{s}={row.get(s, float('nan')):.1f}ms" for s in stages + ["total"]))
+        time.sleep(0.2)
+    eng.stop()
+
+    med = {s: statistics.median([r[s] for r in rows if s in r])
+           for s in stages + ["total"] if any(s in r for r in rows)}
+    print("[ttft] MEDIAN  " + "  ".join(f"{s}={v:.1f}ms"
+                                        for s, v in med.items()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Milvus HTTP-v2 client against an in-process stub server.
+
+The stub implements the exact REST surface the client speaks
+(collections/has|create, entities/insert|search|query|delete) with an
+in-memory exact-IP index, so the wire contract is pinned hermetically —
+the same strategy the suite uses for the OpenAI connector (fakes behind
+the real HTTP stack, SURVEY.md §4 "fake backends" implication).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.rag.milvus_store import (
+    MilvusError, MilvusVectorStore)
+
+
+class _StubMilvus(BaseHTTPRequestHandler):
+    store = None  # class-level: {"rows": [...], "collections": {...}}
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _reply(self, data, code=0):
+        body = json.dumps({"code": code, "data": data}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        req = json.loads(self.rfile.read(n) or b"{}")
+        s = type(self).store
+        path = self.path
+        if path == "/v2/vectordb/collections/has":
+            self._reply({"has": req["collectionName"] in s["collections"]})
+        elif path == "/v2/vectordb/collections/create":
+            s["collections"][req["collectionName"]] = {
+                "dim": req["dimension"], "metric": req.get("metricType")}
+            self._reply({})
+        elif path == "/v2/vectordb/entities/insert":
+            ids = []
+            for row in req["data"]:
+                rid = s["next_id"]
+                s["next_id"] += 1
+                s["rows"].append({"id": rid, **row})
+                ids.append(rid)
+            self._reply({"insertCount": len(ids), "insertIds": ids})
+        elif path == "/v2/vectordb/entities/search":
+            q = np.asarray(req["data"][0], np.float32)
+            hits = []
+            for r in s["rows"]:
+                score = float(np.dot(np.asarray(r["vector"], np.float32), q))
+                hits.append({"distance": score,
+                             **{f: r.get(f) for f in req["outputFields"]}})
+            hits.sort(key=lambda h: -h["distance"])
+            self._reply(hits[: req["limit"]])
+        elif path == "/v2/vectordb/entities/query":
+            flt = req.get("filter", "")
+            fields = req.get("outputFields", [])
+            if fields == ["count(*)"]:
+                self._reply([{"count(*)": len(s["rows"])}])
+                return
+            rows = s["rows"]
+            if flt == 'filename != ""':
+                rows = [r for r in rows if r.get("filename")]
+            self._reply([{f: r.get(f) for f in fields} for r in rows][
+                : req.get("limit", 16384)])
+        elif path == "/v2/vectordb/entities/delete":
+            flt = req["filter"]  # 'filename in ["a", "b"]'
+            names = set(json.loads(flt.split(" in ", 1)[1]))
+            s["rows"] = [r for r in s["rows"]
+                         if r.get("filename") not in names]
+            self._reply({})
+        else:
+            self._reply({}, code=1100)
+
+
+@pytest.fixture()
+def stub_server():
+    _StubMilvus.store = {"rows": [], "collections": {}, "next_id": 100}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubMilvus)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+class TestMilvusClient:
+    def test_roundtrip_add_search_list_delete(self, stub_server):
+        store = MilvusVectorStore(stub_server, dim=4)
+        assert "gaie_chunks" in _StubMilvus.store["collections"]
+        vecs = np.eye(4, dtype=np.float32)
+        ids = store.add(["a", "b", "c", "d"], vecs,
+                        [{"filename": "x.pdf"}, {"filename": "x.pdf"},
+                         {"filename": "y.pdf"}, {}])
+        assert len(ids) == 4
+        assert len(store) == 4
+        hits = store.search(np.asarray([0, 1, 0, 0], np.float32), top_k=2)
+        assert hits[0].text == "b"
+        assert hits[0].score == pytest.approx(1.0)
+        assert hits[0].metadata["filename"] == "x.pdf"
+        assert store.list_documents() == ["x.pdf", "y.pdf"]
+        removed = store.delete_documents(["x.pdf"])
+        assert removed == 2
+        assert len(store) == 2
+        assert store.list_documents() == ["y.pdf"]
+
+    def test_score_threshold_filters(self, stub_server):
+        store = MilvusVectorStore(stub_server, dim=2)
+        store.add(["hi", "lo"], np.asarray([[1, 0], [0.1, 0]], np.float32))
+        hits = store.search(np.asarray([1, 0], np.float32), top_k=4,
+                            score_threshold=0.5)
+        assert [h.text for h in hits] == ["hi"]
+
+    def test_unreachable_server_fails_loudly(self):
+        with pytest.raises(MilvusError, match="unreachable"):
+            MilvusVectorStore("http://127.0.0.1:9", dim=4, timeout=0.5)
+
+    def test_missing_url_fails_loudly(self):
+        with pytest.raises(MilvusError, match="requires vector_store.url"):
+            MilvusVectorStore("", dim=4)
+
+
+class TestFactorySelection:
+    def test_milvus_selected_not_remapped(self, stub_server, default_config):
+        import dataclasses
+
+        from generativeaiexamples_tpu.rag.vectorstore import (
+            create_vector_store)
+
+        cfg = dataclasses.replace(
+            default_config,
+            vector_store=dataclasses.replace(
+                default_config.vector_store, name="milvus", url=stub_server))
+        store = create_vector_store(cfg, dim=4)
+        assert isinstance(store, MilvusVectorStore)
+
+    def test_pgvector_rejected_with_clear_error(self, default_config):
+        import dataclasses
+
+        from generativeaiexamples_tpu.rag.vectorstore import (
+            create_vector_store)
+
+        cfg = dataclasses.replace(
+            default_config,
+            vector_store=dataclasses.replace(
+                default_config.vector_store, name="pgvector"))
+        with pytest.raises(ValueError, match="pgvector"):
+            create_vector_store(cfg, dim=4)

@@ -22,3 +22,22 @@ def test_package_data_ships():
                     "ui/static/kb.html", "ui/static/kb.js",
                     "ui/static/app.css", "native/sdr_ring.c"):
             assert os.path.exists(os.path.join(pkg, rel)), f"missing {rel}"
+
+
+def test_configuration_docs_not_stale():
+    """docs/configuration.md is generated from config/schema.py —
+    regenerate in-memory and compare (drift guard)."""
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "scripts"))
+    try:
+        import gen_config_docs
+
+        with open(os.path.join(root, "docs", "configuration.md")) as fh:
+            assert fh.read() == gen_config_docs.render(), (
+                "docs/configuration.md stale — run "
+                "python scripts/gen_config_docs.py")
+    finally:
+        sys.path.pop(0)

@@ -141,3 +141,72 @@ def test_playground_kb_proxy(tmp_path):
         assert (await r.json())["documents"] == []
 
     _with_stack(tmp_path, body)
+
+
+def test_playground_voice_round_trip(tmp_path):
+    """Mic WAV -> /api/transcribe -> text; reply text -> /api/speech ->
+    decodable WAV (the reference's Riva round-trip, asr_utils.py:42-152 /
+    tts_utils.py:77-127, through the pluggable seam with fakes)."""
+    import numpy as np
+
+    from generativeaiexamples_tpu.streaming.asr import (
+        FakeASR, FakeTTS, pcm_to_wav_bytes, wav_bytes_to_pcm)
+
+    async def body(tmp_path):
+        chain = _make_chain(tmp_path)
+        chain_srv = TestServer(chain.app)
+        await chain_srv.start_server()
+        client = ChatClient(f"http://{chain_srv.host}:{chain_srv.port}",
+                            "test-model")
+        asr = FakeASR(script=["what is a tpu"])
+        ui = TestClient(TestServer(
+            PlaygroundServer(client, asr=asr, tts=FakeTTS()).app))
+        await ui.start_server()
+        try:
+            r = await ui.get("/api/voice")
+            assert await r.json() == {"asr": True, "tts": True}
+
+            tone = (np.sin(np.arange(16000) / 10) * 8000).astype(np.int16)
+            r = await ui.post("/api/transcribe",
+                              data=pcm_to_wav_bytes(tone, 16000),
+                              headers={"Content-Type": "audio/wav"})
+            assert r.status == 200, await r.text()
+            assert (await r.json())["text"] == "what is a tpu"
+            assert asr.calls == 1
+
+            r = await ui.post("/api/speech", json={"text": "a tpu is a chip"})
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "audio/wav"
+            pcm, rate = wav_bytes_to_pcm(await r.read())
+            assert rate == 16000 and len(pcm) > 0
+
+            r = await ui.post("/api/speech", json={"text": ""})
+            assert r.status == 422
+        finally:
+            await ui.close()
+            await chain_srv.close()
+
+    asyncio.run(body(tmp_path))
+
+
+def test_playground_voice_unconfigured_501(tmp_path):
+    async def body(tmp_path):
+        chain = _make_chain(tmp_path)
+        chain_srv = TestServer(chain.app)
+        await chain_srv.start_server()
+        client = ChatClient(f"http://{chain_srv.host}:{chain_srv.port}",
+                            "test-model")
+        ui = TestClient(TestServer(PlaygroundServer(client).app))
+        await ui.start_server()
+        try:
+            r = await ui.get("/api/voice")
+            assert await r.json() == {"asr": False, "tts": False}
+            r = await ui.post("/api/transcribe", data=b"x")
+            assert r.status == 501
+            r = await ui.post("/api/speech", json={"text": "hi"})
+            assert r.status == 501
+        finally:
+            await ui.close()
+            await chain_srv.close()
+
+    asyncio.run(body(tmp_path))
