@@ -118,6 +118,7 @@ class MilvusVectorStore:
         } for t, emb, m in zip(texts, embeddings, metadatas)]
         out = self._post("/v2/vectordb/entities/insert",
                          {"collectionName": self.collection, "data": rows})
+        self._invalidate()
         ids = out.get("data", {}).get("insertIds", [])
         return [int(i) for i in ids] if ids else list(range(len(texts)))
 
@@ -160,12 +161,24 @@ class MilvusVectorStore:
         names = [str(n) for n in filenames]
         if not names:
             return 0
-        before = len(self)
-        self._post("/v2/vectordb/entities/delete", {
+        # Count the matching rows BEFORE deleting (one filtered query):
+        # Milvus applies deletes asynchronously, so a count(*) taken
+        # right after the delete may still see the rows and a
+        # before/after diff would report 0 for a successful delete.
+        flt = f"filename in {json.dumps(names)}"
+        probe = self._post("/v2/vectordb/entities/query", {
             "collectionName": self.collection,
-            "filter": f"filename in {json.dumps(names)}",
+            "filter": flt,
+            "outputFields": ["count(*)"],
+        }).get("data", [])
+        matching = int(probe[0].get("count(*)", 0)) if probe else 0
+        out = self._post("/v2/vectordb/entities/delete", {
+            "collectionName": self.collection,
+            "filter": flt,
         })
-        return max(0, before - len(self))
+        self._invalidate()
+        dc = (out.get("data") or {}).get("deleteCount")
+        return int(dc) if dc is not None else matching
 
     def __len__(self) -> int:
         out = self._post("/v2/vectordb/entities/query", {
@@ -178,9 +191,22 @@ class MilvusVectorStore:
             return int(data[0]["count(*)"])
         return len(data)
 
+    def _invalidate(self) -> None:
+        self._docs_cache = None
+
     def snapshot_docs(self):
         """Doc dump for the hybrid retriever's lexical leg (bounded —
-        external stores beyond this size should rely on dense-only)."""
+        external stores beyond this size should rely on dense-only).
+
+        Cached between mutations made THROUGH this client: the hybrid
+        retriever calls snapshot_docs per query, and a full-collection
+        HTTP dump per chat turn would dwarf the retrieval itself.
+        Mutations from other processes are not observed until this
+        process next mutates — acceptable for the lexical re-ranking
+        leg (dense retrieval always sees the live server)."""
+        cached = getattr(self, "_docs_cache", None)
+        if cached is not None:
+            return cached
         out = self._post("/v2/vectordb/entities/query", {
             "collectionName": self.collection,
             "filter": "",
@@ -194,4 +220,5 @@ class MilvusVectorStore:
             except (TypeError, json.JSONDecodeError):
                 meta = {}
             docs.append({"text": r.get("text", ""), "metadata": meta})
+        self._docs_cache = docs
         return docs

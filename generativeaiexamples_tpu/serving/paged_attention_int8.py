@@ -18,10 +18,13 @@ Layouts (per layer, matching kv_cache.PagePool):
   page_table [B, maxp] int32   page ids (0 = garbage sink)
   lengths    [B] int32         valid tokens INCLUDING the current one
 
-Kernel shape: grid (B, KH); the whole sequence loop for one (batch row,
-kv head) runs inside one grid step as a fori_loop over compute blocks
-of `pages_per_compute_block` pages. Pages stream HBM->VMEM through
-double-buffered async copies (the scale rows ride the same semaphore).
+Kernel shape: grid (B,) — ONE grid step per batch row covering ALL kv
+heads, as a fori_loop over compute blocks of `pages_per_compute_block`
+pages. Each page moves HBM->VMEM as a single DMA descriptor STRIDED
+across the KH axis, and the next block's copies start while the
+current one computes (cross-grid-step double buffering) — descriptor
+count, not bandwidth, is the measured floor at decode shapes (see
+_int8_kernel's docstring and docs/ENGINEERING_NOTES.md).
 Dequantization never touches head_dim: K scales multiply the score
 columns ((q @ k_q^T) * ks == q @ (k_q * ks)^T), V scales fold into the
 softmax weights before the PV matmul — the VPU work per block is

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.models import llama
-from generativeaiexamples_tpu.serving import engine_model, paged_attention
+from generativeaiexamples_tpu.serving import engine_model
 from generativeaiexamples_tpu.serving.kv_cache import PagePool
 from scripts.bench_params import build_params_on_device
 
@@ -41,8 +41,10 @@ def main():
     pool = PagePool.zeros(cfg, n_pages, ps, dtype=jnp.dtype(kv))
 
     if stub_attn:
-        orig = paged_attention.paged_attention_dispatch
-        paged_attention.paged_attention_dispatch = (
+        # Patch the ENGINE's binding: engine_model imports the dispatch
+        # function at module level, so patching the source module
+        # (paged_attention) would be a no-op.
+        engine_model.paged_attention_dispatch = (
             lambda q, *a, **k: q)  # skip the kernel, keep shapes
     if stub_quant:
         from generativeaiexamples_tpu.serving import paged_attention_int8 as pi
@@ -50,8 +52,9 @@ def main():
         def fake_quant(x, scale_dtype=jnp.float32):
             return (x.astype(jnp.int8),
                     jnp.ones(x.shape[:-1], scale_dtype))
+        # engine_model imports quantize_kv function-locally at trace
+        # time, so patching the source module reaches it.
         pi.quantize_kv = fake_quant
-        engine_model_quant = fake_quant  # noqa: F841
 
     rng = np.random.default_rng(0)
     tables = np.zeros((B, maxp), np.int32)
@@ -71,12 +74,13 @@ def main():
             sampling_flags=(True, False, False))
 
     block, last, pool = step(last, pool, lengths)
-    jax.block_until_ready(block)  # compile
+    np.asarray(block)  # compile + real completion (block_until_ready is
+    # NOT a reliable sync through the axon tunnel — ENGINEERING_NOTES)
     n = 4
     t0 = time.perf_counter()
     for i in range(n):
         block, last, pool = step(last, pool, lengths + 8 * (i + 1))
-    jax.block_until_ready(block)
+        np.asarray(block)
     dt = (time.perf_counter() - t0) / (n * 8) * 1e3
     tag = f"B={B} kv={kv} stub_attn={stub_attn} stub_quant={stub_quant}"
     print(f"[decompose] {tag}: {dt:.2f} ms per decode iteration "

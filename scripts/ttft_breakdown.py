@@ -16,6 +16,7 @@ docs/ENGINEERING_NOTES.md.
 
 from __future__ import annotations
 
+import os
 import statistics
 import sys
 import time
@@ -34,7 +35,8 @@ from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer  # noqa: E402
 
 
 def main() -> None:
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from scripts.bench_params import build_params_on_device
 
     n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 9

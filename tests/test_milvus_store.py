@@ -62,12 +62,15 @@ class _StubMilvus(BaseHTTPRequestHandler):
         elif path == "/v2/vectordb/entities/query":
             flt = req.get("filter", "")
             fields = req.get("outputFields", [])
-            if fields == ["count(*)"]:
-                self._reply([{"count(*)": len(s["rows"])}])
-                return
             rows = s["rows"]
             if flt == 'filename != ""':
                 rows = [r for r in rows if r.get("filename")]
+            elif flt.startswith("filename in "):
+                names = set(json.loads(flt.split(" in ", 1)[1]))
+                rows = [r for r in rows if r.get("filename") in names]
+            if fields == ["count(*)"]:
+                self._reply([{"count(*)": len(rows)}])
+                return
             self._reply([{f: r.get(f) for f in fields} for r in rows][
                 : req.get("limit", 16384)])
         elif path == "/v2/vectordb/entities/delete":
@@ -152,3 +155,22 @@ class TestFactorySelection:
                 default_config.vector_store, name="pgvector"))
         with pytest.raises(ValueError, match="pgvector"):
             create_vector_store(cfg, dim=4)
+
+
+class TestSnapshotCache:
+    def test_snapshot_cached_and_invalidated(self, stub_server):
+        store = MilvusVectorStore(stub_server, dim=2)
+        store.add(["one"], np.asarray([[1, 0]], np.float32),
+                  [{"filename": "a.txt"}])
+        first = store.snapshot_docs()
+        assert [d["text"] for d in first] == ["one"]
+        # Served from cache: mutate the stub behind the client's back.
+        _StubMilvus.store["rows"].append(
+            {"id": 999, "vector": [0, 1], "text": "ghost",
+             "filename": "g.txt", "meta": "{}"})
+        assert store.snapshot_docs() is first
+        # A mutation through the client invalidates.
+        store.add(["two"], np.asarray([[0, 1]], np.float32),
+                  [{"filename": "b.txt"}])
+        texts = {d["text"] for d in store.snapshot_docs()}
+        assert {"one", "two", "ghost"} <= texts
