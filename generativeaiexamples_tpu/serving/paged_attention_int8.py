@@ -1,4 +1,4 @@
-"""Paged decode attention over an int8 KV pool with NARROW scales.
+"""Paged decode attention over a FUSED int8 KV pool with narrow scales.
 
 Why this kernel exists (VERDICT r2 next-step #1b): bf16 KV caps the
 engine at B=64 on a 16 GB v5e (B=128 OOMs; docs/ENGINEERING_NOTES.md),
@@ -8,27 +8,30 @@ tokens/sec *if the KV pool fits and stays cheap to read*. int8 KV
 halves pool bytes. The stdlib JetStream-style kernel's quantized path
 is useless for this: it broadcasts f32 scales to head_dim width
 (5 B/token-elem effective vs bf16's 2) AND materializes the broadcast
-in HBM. Here scales are one f32 per (kv-head, token): 4 bytes next to
-the 128-byte int8 token row — 3% overhead instead of 200%.
+in HBM. Here scales are one f32 per (kv-head, k|v, token): 4 bytes
+next to the 128-byte int8 token row — 3% overhead instead of 200%.
 
-Layouts (per layer, matching kv_cache.PagePool):
-  q          [B, H, Hd]        softmax scale PRE-FOLDED by the caller
-  k_pages    [KH, P, ps, Hd]   int8
-  k_scales   [KH, P, ps]       f32  (amax/127 over Hd at write time)
-  page_table [B, maxp] int32   page ids (0 = garbage sink)
-  lengths    [B] int32         valid tokens INCLUDING the current one
+Layouts (per layer, matching kv_cache.QuantPagePool):
+  q          [B, H, Hd]          softmax scale PRE-FOLDED by the caller
+  kv_pages   [2, KH, P, ps, Hd]  int8; [0] = k, [1] = v
+  kv_scales  [2, KH, P, ps]      bf16/f32 (amax/127 over Hd at write)
+  page_table [B, maxp] int32     page ids (0 = garbage sink)
+  lengths    [B] int32           valid tokens INCLUDING the current one
 
 Kernel shape: grid (B,) — ONE grid step per batch row covering ALL kv
 heads, as a fori_loop over compute blocks of `pages_per_compute_block`
-pages. Each page moves HBM->VMEM as a single DMA descriptor STRIDED
-across the KH axis, and the next block's copies start while the
-current one computes (cross-grid-step double buffering) — descriptor
-count, not bandwidth, is the measured floor at decode shapes (see
-_int8_kernel's docstring and docs/ENGINEERING_NOTES.md).
+pages. Each page's k AND v move HBM->VMEM as a SINGLE DMA descriptor
+strided across the (KH, 2) axes, and both scale rows as one more —
+2 descriptors per page instead of the 4 an unfused pool needs and the
+8 a per-head grid pays. Descriptor issue count, not bandwidth, is the
+measured floor at decode shapes (scripts/decompose_decode.py;
+docs/ENGINEERING_NOTES.md r3 notes). The next block's copies start
+while the current one computes (cross-grid-step double buffering).
+
 Dequantization never touches head_dim: K scales multiply the score
 columns ((q @ k_q^T) * ks == q @ (k_q * ks)^T), V scales fold into the
 softmax weights before the PV matmul — the VPU work per block is
-O(G x bk), not O(bk x Hd).
+O(KH x G x bk), not O(bk x Hd).
 
 No reference-repo counterpart: the reference delegates KV management to
 TRT-LLM inside NIM (SURVEY.md §2.3).
@@ -63,13 +66,14 @@ def quantize_kv(x: jax.Array, scale_dtype=jnp.float32):
 
 def dequantize_pages(q_pages: jax.Array, scales: jax.Array,
                      dtype=jnp.float32) -> jax.Array:
-    """[KH, P, ps, Hd] int8 + [KH, P, ps] -> float pages (CPU oracle)."""
+    """[..., ps, Hd] int8 + [..., ps] -> float pages (CPU oracle)."""
     return q_pages.astype(dtype) * scales.astype(dtype)[..., None]
 
 
 def paged_attention_int8_reference(q, k_pages, k_scales, v_pages, v_scales,
                                    page_table, lengths, *, scale=None):
-    """Dequantize-then-attend oracle (any backend)."""
+    """Dequantize-then-attend oracle over UNFUSED pages (any backend;
+    numerics tests build k/v separately)."""
     from generativeaiexamples_tpu.serving.paged_attention import (
         paged_attention_reference)
 
@@ -79,59 +83,67 @@ def paged_attention_int8_reference(q, k_pages, k_scales, v_pages, v_scales,
                                      scale=scale).astype(q.dtype)
 
 
+def paged_attention_int8_reference_fused(q, kv_pages, kv_scales, page_table,
+                                         lengths, *, scale=None):
+    """Oracle over the fused [2, KH, P, ps, Hd] layout."""
+    return paged_attention_int8_reference(
+        q, kv_pages[0], kv_scales[0], kv_pages[1], kv_scales[1],
+        page_table, lengths, scale=scale)
+
+
+def fuse_kv(kq, ks, vq, vs):
+    """Separate quantized k/v ([KH, P, ps, Hd] + [KH, P, ps]) -> the
+    fused pool layout (tests + oracle comparisons)."""
+    return jnp.stack([kq, vq], axis=0), jnp.stack([ks, vs], axis=0)
+
+
 # ---------------------------------------------------------------------------
 # TPU kernel
 # ---------------------------------------------------------------------------
 
 
-def _copy_block(pages_ref, hbm, buf, sem, b, i, slot, *, ppcb, maxp):
+def _copy_block(pages_ref, layer, hbm, buf, sem, b, i, slot, *, ppcb, maxp):
     """Async copies for compute block i of row b into buffer `slot`:
-    one STRIDED descriptor per page covering ALL kv heads
-    (hbm.at[:, pid] on the [KH, P, ...] pool). Returns the descriptors
+    one STRIDED descriptor per page covering all kv heads AND both of
+    k/v (hbm.at[:, layer, :, pid] on the FULL [2, L, KH, P, ...] pool —
+    the layer is indexed inside the descriptor because a host-side
+    per-layer slice of the kv-leading layout is non-contiguous and XLA
+    would materialize 32 copies of it). Returns the descriptors
     (recreate-and-wait pattern: semaphores count bytes, so identical
     descriptors built later can wait)."""
     copies = []
     for j in range(ppcb):
         pid = pages_ref[b * maxp + i * ppcb + j]
         copies.append(pltpu.make_async_copy(
-            hbm.at[:, pid], buf.at[slot, j], sem.at[slot]))
+            hbm.at[:, layer, :, pid], buf.at[slot, j], sem.at[slot]))
     return copies
 
 
 def _int8_kernel(
     lengths_ref,   # scalar prefetch [B]
     tables_ref,    # scalar prefetch [B * maxp]
+    layer_ref,     # scalar prefetch [1] — which layer's pool slice
     buf_idx_ref,   # scalar prefetch [1] — persists ACROSS grid steps
     init_ref,      # scalar prefetch [1] — 1 on the very first grid step
     q_ref,         # [1, KH, G, Hd] f32 (scale pre-folded)
-    kq_hbm,        # [KH, P, ps, Hd] int8 (ANY)
-    ks_hbm,        # [KH, P, 1, ps] f32 (ANY)
-    vq_hbm,
-    vs_hbm,
+    kv_hbm,        # [2, L, KH, P, ps, Hd] int8 (ANY)
+    s_hbm,         # [2, L, KH, P, 1, ps] f32 (ANY)
     o_ref,         # [1, KH, G, Hd]
-    kq_buf,        # VMEM [2, ppcb, KH, ps, Hd] int8
-    ks_buf,        # VMEM [2, ppcb, KH, 1, ps] f32
-    vq_buf,
-    vs_buf,
-    k_sem,         # DMA sems [2]
-    v_sem,
+    kv_buf,        # VMEM [2, ppcb, 2, KH, ps, Hd] int8
+    s_buf,         # VMEM [2, ppcb, 2, KH, 1, ps] f32
+    sem,           # DMA sems [2]
     *,
     ppcb: int,
     maxp: int,
     page_size: int,
     batch_size: int,
 ):
-    """One grid step per BATCH ROW, all kv heads together.
+    """One grid step per BATCH ROW, all kv heads + k and v together.
 
-    Two design rules, both measured on a v5e through the decode path
-    (scripts/decompose_decode.py: attention was 35 of 73 ms/iteration
-    at B=128 before them):
-
-    1. DMA-issue count is the floor. A (B, KH) grid issues
-       B x KH x pages x 4 copies per layer (12k at B=128); one grid
-       step per row with per-page descriptors STRIDED across the KH
-       axis cuts that 8x — the DMA engine walks the head stride, the
-       scalar core issues once.
+    Design rules, measured on a v5e through the real decode path
+    (scripts/decompose_decode.py):
+    1. DMA-issue count is the floor — fused pages cut it to 2
+       descriptors per page.
     2. Latency hiding is CROSS-grid-step (the JetStream scheme): while
        row b's block computes, the next block's copies are already in
        flight in the other buffer; buf_idx/init persist in SMEM across
@@ -143,15 +155,13 @@ def _int8_kernel(
     nblk = lax.div(length + bk - 1, bk)
     KH, G, Hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
 
+    layer = layer_ref[0]
+
     def copies(bb, i, slot):
-        out = []
-        for hbm, buf, sem in ((kq_hbm, kq_buf, k_sem),
-                              (ks_hbm, ks_buf, k_sem),
-                              (vq_hbm, vq_buf, v_sem),
-                              (vs_hbm, vs_buf, v_sem)):
-            out.extend(_copy_block(tables_ref, hbm, buf, sem, bb, i, slot,
-                                   ppcb=ppcb, maxp=maxp))
-        return out
+        return (_copy_block(tables_ref, layer, kv_hbm, kv_buf, sem, bb, i,
+                            slot, ppcb=ppcb, maxp=maxp)
+                + _copy_block(tables_ref, layer, s_hbm, s_buf, sem, bb, i,
+                              slot, ppcb=ppcb, maxp=maxp))
 
     def next_block(i):
         """Block after (b, i-1): block i of this row if still inside
@@ -188,10 +198,10 @@ def _int8_kernel(
         carry_i = carry
         for j in range(ppcb):
             m_prev, l_prev, acc = carry_i
-            kq = kq_buf[slot, j].astype(jnp.float32)  # [KH, ps, Hd]
-            ks = ks_buf[slot, j]                      # [KH, 1, ps]
-            vq = vq_buf[slot, j].astype(jnp.float32)
-            vs = vs_buf[slot, j]
+            kq = kv_buf[slot, j, 0].astype(jnp.float32)  # [KH, ps, Hd]
+            vq = kv_buf[slot, j, 1].astype(jnp.float32)
+            ks = s_buf[slot, j, 0]                       # [KH, 1, ps]
+            vs = s_buf[slot, j, 1]
             s = jax.lax.dot_general(
                 q, kq, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * ks  # [KH, G, ps]
@@ -228,12 +238,11 @@ def _pages_per_block(maxp: int, want: int) -> int:
                                              "pages_per_compute_block"))
 def paged_attention_int8(
     q: jax.Array,          # [B, H, Hd]
-    k_pages: jax.Array,    # [KH, P, ps, Hd] int8
-    k_scales: jax.Array,   # [KH, P, ps] f32
-    v_pages: jax.Array,
-    v_scales: jax.Array,
+    kv_pages: jax.Array,   # FULL pool [2, L, KH, P, ps, Hd] int8
+    kv_scales: jax.Array,  # FULL scales [2, L, KH, P, ps] f32
     page_table: jax.Array,  # [B, maxp] int32
     lengths: jax.Array,     # [B] int32, incl. current token
+    layer,                  # int32 scalar: which layer to attend over
     *,
     scale: float | None = None,
     pages_per_compute_block: int | None = None,
@@ -241,38 +250,34 @@ def paged_attention_int8(
     if pltpu is None:
         raise RuntimeError("Pallas TPU unavailable; use the reference path")
     B, H, Hd = q.shape
-    KH, P, ps, _ = k_pages.shape
+    two, L, KH, P, ps, _ = kv_pages.shape
+    assert two == 2, kv_pages.shape
     maxp = page_table.shape[1]
     G = H // KH
     s = scale if scale is not None else Hd ** -0.5
     ppcb = _pages_per_block(maxp, pages_per_compute_block or 8)
 
     qk = (q.astype(jnp.float32) * s).reshape(B, KH, G, Hd)
-    # Scale pages as 2-D [1, ps] tiles (metadata-only reshape): the
-    # kernel DMAs and consumes them without any vector relayout.
-    ks2 = k_scales.reshape(KH, P, 1, ps)
-    vs2 = v_scales.reshape(KH, P, 1, ps)
+    # Scale pages as 2-D [1, ps] tiles (metadata-only reshape of the
+    # CONTIGUOUS full array): the kernel DMAs and consumes them without
+    # any vector relayout.
+    s2 = kv_scales.reshape(2, L, KH, P, 1, ps)
 
     kernel = functools.partial(_int8_kernel, ppcb=ppcb, maxp=maxp,
                                page_size=ps, batch_size=B)
-    qmap = lambda b, L, T, BI, IF: (b, 0, 0, 0)  # noqa: E731
+    qmap = lambda b, Ln, T, LY, BI, IF: (b, 0, 0, 0)  # noqa: E731
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, KH, G, Hd), qmap),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=pl.BlockSpec((1, KH, G, Hd), qmap),
         scratch_shapes=[
-            pltpu.VMEM((2, ppcb, KH, ps, Hd), jnp.int8),
-            pltpu.VMEM((2, ppcb, KH, 1, ps), jnp.float32),
-            pltpu.VMEM((2, ppcb, KH, ps, Hd), jnp.int8),
-            pltpu.VMEM((2, ppcb, KH, 1, ps), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, ppcb, 2, KH, ps, Hd), jnp.int8),
+            pltpu.VMEM((2, ppcb, 2, KH, 1, ps), kv_scales.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
@@ -285,6 +290,7 @@ def paged_attention_int8(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1),
       jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
-      qk, k_pages, ks2, v_pages, vs2)
+      qk, kv_pages, s2)
     return out.reshape(B, H, Hd).astype(q.dtype)

@@ -28,9 +28,11 @@ from generativeaiexamples_tpu.ops.quant import QuantizedTensor
 # tensor axis, matching wk/wv's output-dim sharding so decode's KV
 # read/write never crosses chips.
 KV_POOL_SPEC = P(None, "tensor", None, None, None)
-# int8 pools carry narrow per-token scales [L, KH, P, page_size]; same
-# kv-head axis on tensor (serving/paged_attention_int8.py).
-KV_SCALE_SPEC = P(None, "tensor", None, None)
+# Fused int8 pools lead with the k|v axis: codes [2, L, KH, P, ps, Hd]
+# and narrow scales [2, L, KH, P, ps] — kv-heads (the TP axis) sit at
+# axis 2 (kv_cache.QuantPagePool, serving/paged_attention_int8.py).
+KV_FUSED_SPEC = P(None, None, "tensor")
+KV_FUSED_SCALE_SPEC = P(None, None, "tensor")
 
 
 def tensor_axis_size(mesh: Optional[Mesh]) -> int:

@@ -34,11 +34,23 @@ class PlaygroundServer:
     tts_utils.py:77-127) behind pluggable endpoints."""
 
     def __init__(self, client: ChatClient, asr=None, tts=None,
-                 voice_sample_rate: int = 16000) -> None:
+                 voice_sample_rate: int = 16000,
+                 feedback_path: str = "") -> None:
         self.client = client
         self.asr = asr
         self.tts = tts
         self.voice_sample_rate = voice_sample_rate
+        # User feedback log (reference: oran-chatbot utils/feedback.py
+        # appends rated turns for later analysis). JSONL, append-only.
+        # Default lives under the user's state dir, NOT the shared temp
+        # dir (a predictable /tmp name invites symlink-following writes
+        # and cross-user interleaving on shared hosts).
+        state_dir = os.environ.get(
+            "XDG_STATE_HOME", os.path.join(os.path.expanduser("~"),
+                                           ".local", "state"))
+        self.feedback_path = feedback_path or os.path.join(
+            state_dir, "gaie_tpu", "feedback.jsonl")
+        self._feedback_lock = asyncio.Lock()
         self.app = web.Application(client_max_size=100 * 1024 * 1024)
         self.app.add_routes([
             web.get("/", self.page_converse),
@@ -53,6 +65,7 @@ class PlaygroundServer:
             web.get("/api/voice", self.handle_voice_caps),
             web.post("/api/transcribe", self.handle_transcribe),
             web.post("/api/speech", self.handle_speech),
+            web.post("/api/feedback", self.handle_feedback),
         ])
         self.app.router.add_static("/static", STATIC_DIR)
 
@@ -193,6 +206,38 @@ class PlaygroundServer:
         pcm = await asyncio.to_thread(self.tts.synthesize, text, rate)
         return web.Response(body=pcm_to_wav_bytes(pcm, rate),
                             content_type="audio/wav")
+
+    # -- feedback (reference: oran-chatbot utils/feedback.py) --------------
+
+    async def handle_feedback(self, request: web.Request) -> web.Response:
+        """{"rating": 1|-1, "query": ..., "response": ..., "comment"?}
+        appended to the feedback JSONL for offline analysis."""
+        import time as _time
+
+        try:
+            body = await request.json()
+            rating = int(body.get("rating"))
+        except (json.JSONDecodeError, AttributeError, TypeError, ValueError):
+            return web.json_response(
+                {"detail": "expected JSON object with integer rating"},
+                status=422)
+        if rating not in (-1, 1):
+            return web.json_response({"detail": "rating must be 1 or -1"},
+                                     status=422)
+        row = {"ts": _time.time(), "rating": rating,
+               "query": str(body.get("query", ""))[:4096],
+               "response": str(body.get("response", ""))[:16384],
+               "comment": str(body.get("comment", ""))[:4096],
+               "use_knowledge_base": bool(body.get("use_knowledge_base",
+                                                   False))}
+        async with self._feedback_lock:
+            def append():
+                os.makedirs(os.path.dirname(self.feedback_path) or ".",
+                            exist_ok=True)
+                with open(self.feedback_path, "a") as fh:
+                    fh.write(json.dumps(row) + "\n")
+            await asyncio.to_thread(append)
+        return web.json_response({"message": "feedback recorded"})
 
 
 def run_server(server: PlaygroundServer, host: str, port: int) -> None:

@@ -76,9 +76,43 @@ form.addEventListener("submit", async (ev) => {
   } finally {
     sendBtn.disabled = false;
     input.focus();
-    if (speakBox && speakBox.checked && bot.textContent) speak(bot.textContent);
+    // Capture the answer text BEFORE the feedback bar is appended —
+    // botDiv.textContent would otherwise include the button glyphs in
+    // both the TTS audio and the logged feedback rows.
+    const answer = bot.textContent;
+    addFeedback(bot, query, answer);
+    if (speakBox && speakBox.checked && answer) speak(answer);
   }
 });
+
+// --- feedback capture (reference: oran-chatbot utils/feedback.py) ----
+function addFeedback(botDiv, query, answer) {
+  const bar = document.createElement("div");
+  bar.className = "feedback";
+  for (const [label, rating] of [["👍", 1], ["👎", -1]]) {
+    const b = document.createElement("button");
+    b.type = "button";
+    b.textContent = label;
+    b.addEventListener("click", async () => {
+      bar.querySelectorAll("button").forEach((x) => (x.disabled = true));
+      b.classList.add("chosen");
+      try {
+        await fetch("/api/feedback", {
+          method: "POST",
+          headers: { "Content-Type": "application/json" },
+          body: JSON.stringify({
+            rating: rating,
+            query: query,
+            response: answer,
+            use_knowledge_base: useKb.checked,
+          }),
+        });
+      } catch (e) { /* best-effort */ }
+    });
+    bar.appendChild(b);
+  }
+  botDiv.appendChild(bar);
+}
 
 // --- voice path (reference: Riva ASR/TTS in the frontend;
 // asr_utils.py start_recording / tts_utils.py text_to_speech) ---------

@@ -23,7 +23,8 @@ import numpy as np
 from generativeaiexamples_tpu.serving.paged_attention import (
     paged_attention_dispatch)
 from generativeaiexamples_tpu.serving.paged_attention_int8 import (
-    paged_attention_int8, paged_attention_int8_reference, quantize_kv)
+    fuse_kv, paged_attention_int8, paged_attention_int8_reference,
+    quantize_kv)
 
 
 def main():
@@ -38,6 +39,7 @@ def main():
     v = jax.random.normal(ks_[2], (KH, P, ps, Hd), jnp.float32)
     kq, ks = quantize_kv(k)
     vq, vs = quantize_kv(v)
+    kv, s = fuse_kv(kq, ks, vq, vs)
     rng = np.random.default_rng(0)
     table = np.zeros((B, maxp), np.int32)
     perm = rng.permutation(np.arange(1, P))
@@ -47,7 +49,8 @@ def main():
     lengths = jnp.asarray(
         rng.integers(1, maxp * ps + 1, (B,)).astype(np.int32))
 
-    got = paged_attention_int8(q, kq, ks, vq, vs, table, lengths)
+    kv_full, s_full = kv[:, None], s[:, None]  # L=1 pool
+    got = paged_attention_int8(q, kv_full, s_full, table, lengths, 0)
     want = paged_attention_int8_reference(
         q.astype(jnp.float32), kq, ks, vq, vs, table, lengths)
     err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
@@ -65,8 +68,8 @@ def main():
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / n * 1e3
 
-    t_int8 = timeit(lambda: paged_attention_int8(q, kq, ks, vq, vs, table,
-                                                 lengths))
+    t_int8 = timeit(lambda: paged_attention_int8(q, kv_full, s_full, table,
+                                                 lengths, 0))
     kb = k.astype(jnp.bfloat16)
     vb = v.astype(jnp.bfloat16)
     t_bf16 = timeit(lambda: paged_attention_dispatch(q, kb, vb, table,

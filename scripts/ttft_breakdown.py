@@ -57,7 +57,7 @@ def main() -> None:
 
     orig_prefill = eng._prefill_group
     orig_dispatch = eng._dispatch_decode
-    orig_process = eng._process_block_inner
+    orig_process = eng._process_block_host
 
     def prefill_group(bucket, entries):
         marks.setdefault("admit", time.perf_counter())
@@ -71,20 +71,21 @@ def main() -> None:
             marks.setdefault("decode_dispatched", time.perf_counter())
         return out
 
-    def process_block(fl):
+    # The scheduler's blocking fetch happens just before
+    # _process_block_host; fetch_end marks when the first
+    # post-decode-dispatch block lands on the host.
+
+    def process_block(fl, host_block):
         if "decode_dispatched" in marks:
-            marks.setdefault("fetch_start", time.perf_counter())
-        out = orig_process(fl)
-        if "fetch_start" in marks:
             marks.setdefault("fetch_end", time.perf_counter())
-        return out
+        return orig_process(fl, host_block)
 
     eng._prefill_group = prefill_group
     eng._dispatch_decode = dispatch_decode
-    eng._process_block_inner = process_block
+    eng._process_block_host = process_block
 
     stages = ["admit", "prefill_dispatched", "decode_dispatched",
-              "fetch_start", "fetch_end", "first_token"]
+              "fetch_end", "first_token"]
     rows = []
     for r in range(n_req):
         marks.clear()

@@ -81,8 +81,12 @@ class TestInt8PagedAttention:
 
     def test_dispatch_routes_quantized(self):
         q, (kq, ks, vq, vs), _, table, lengths = self._setup()
-        got = paged_attention_dispatch(q, kq, vq, table, lengths,
-                                       k_scales=ks, v_scales=vs,
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            fuse_kv)
+
+        kv, s = fuse_kv(kq, ks, vq, vs)
+        got = paged_attention_dispatch(q, kv[:, None], None, table, lengths,
+                                       k_scales=s[:, None], layer=0,
                                        use_pallas=False)
         want = paged_attention_int8_reference(q, kq, ks, vq, vs, table,
                                               lengths)
@@ -170,13 +174,17 @@ class TestInt8PoolTP:
         lengths = jnp.array([ps * 4, ps * 2 - 1], jnp.int32)
         want = paged_attention_int8_reference(q, kq, ks, vq, vs, table,
                                               lengths)
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            fuse_kv)
+
+        kv, s = fuse_kv(kq, ks, vq, vs)
         # use_pallas=False inside shard_map still exercises the sharded
         # spec plumbing via the mesh branch guard; force mesh branch by
         # calling dispatch with mesh + use_pallas=False -> reference path
         # (no shard_map on CPU). The sharded-spec plumbing itself is
         # compile-checked in dryrun_multichip on the int8 pool.
-        got = paged_attention_dispatch(q, kq, vq, table, lengths,
-                                       k_scales=ks, v_scales=vs,
+        got = paged_attention_dispatch(q, kv[:, None], None, table, lengths,
+                                       k_scales=s[:, None], layer=0,
                                        use_pallas=False, mesh=mesh)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-6)

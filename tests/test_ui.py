@@ -210,3 +210,43 @@ def test_playground_voice_unconfigured_501(tmp_path):
             await chain_srv.close()
 
     asyncio.run(body(tmp_path))
+
+
+def test_playground_feedback_capture(tmp_path):
+    """Thumbs up/down land in the feedback JSONL (reference:
+    oran-chatbot-multimodal/utils/feedback.py role)."""
+    import json as _json
+
+    async def body(tmp_path):
+        chain = _make_chain(tmp_path)
+        chain_srv = TestServer(chain.app)
+        await chain_srv.start_server()
+        client = ChatClient(f"http://{chain_srv.host}:{chain_srv.port}",
+                            "test-model")
+        from generativeaiexamples_tpu.ui.server import PlaygroundServer as PS
+
+        fb = str(tmp_path / "fb.jsonl")
+        ui = TestClient(TestServer(PS(client, feedback_path=fb).app))
+        await ui.start_server()
+        try:
+            r = await ui.post("/api/feedback", json={
+                "rating": 1, "query": "q1", "response": "a1",
+                "use_knowledge_base": True})
+            assert r.status == 200, await r.text()
+            r = await ui.post("/api/feedback", json={
+                "rating": -1, "query": "q2", "response": "a2",
+                "comment": "wrong"})
+            assert r.status == 200
+            r = await ui.post("/api/feedback", json={"rating": 5})
+            assert r.status == 422
+            r = await ui.post("/api/feedback", data=b"junk")
+            assert r.status == 422
+            rows = [_json.loads(ln) for ln in open(fb)]
+            assert [row["rating"] for row in rows] == [1, -1]
+            assert rows[0]["use_knowledge_base"] is True
+            assert rows[1]["comment"] == "wrong"
+        finally:
+            await ui.close()
+            await chain_srv.close()
+
+    asyncio.run(body(tmp_path))
