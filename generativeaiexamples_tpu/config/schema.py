@@ -194,6 +194,11 @@ class EngineConfig:
     max_seq_len: int = 8192
     page_size: int = 128  # KV-cache page (tokens per page)
     prefill_buckets: Tuple[int, ...] = (128, 512, 1024, 2048, 4096)
+    # Largest number of admissions batched into ONE prefill dispatch.
+    # Caps prefill's transient activation/KV memory (a full-batch burst
+    # at max_batch_size=256 would otherwise spike ~2x the steady-state
+    # footprint); 0 = uncapped (group = max_batch_size).
+    max_prefill_group: int = 64
     decode_steps_per_dispatch: int = 8
     # Decode dispatch pipeline depth: blocks enqueued ahead of the host
     # fetch so device compute overlaps result readback (readback latency

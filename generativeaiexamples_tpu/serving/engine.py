@@ -332,13 +332,14 @@ class LLMEngine:
         ps = self.pool.page_size
         if group_sizes is None:
             group_sizes = []
+            bound = min(self.ecfg.max_batch_size, self._prefill_cap)
             n = 1
-            while n < self.ecfg.max_batch_size:
+            while n < bound:
                 group_sizes.append(n)
                 n *= 2
             # _prefill_group pads to the NEXT power of two, so a
-            # non-power-of-two max_batch_size still produces this
-            # variant in live traffic.
+            # non-power-of-two bound still produces this variant in
+            # live traffic; groups never exceed max_prefill_group.
             group_sizes.append(n)
         if ks is None:
             # _dispatch_decode rounds K DOWN to a power of two; warm the
@@ -526,11 +527,18 @@ class LLMEngine:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
 
+    @property
+    def _prefill_cap(self) -> int:
+        cap = self.ecfg.max_prefill_group
+        return cap if cap > 0 else self.ecfg.max_batch_size
+
     def _admit_waiting(self) -> bool:
         """Admit every waiting request with a free slot, grouped by
-        prefill bucket into BATCHED prefill dispatches: a burst of N
-        admissions reads the (bandwidth-dominating) weights once, not N
-        times, collapsing both TTFT under load and startup cost."""
+        prefill bucket into BATCHED prefill dispatches (capped at
+        max_prefill_group per dispatch — prefill transients scale with
+        the group): a burst of N admissions reads the
+        (bandwidth-dominating) weights once per group, not N times,
+        collapsing both TTFT under load and startup cost."""
         groups: Dict[int, List] = {}  # bucket -> [(req, slot_idx, seq, ids)]
         deferred_long: List[GenRequest] = []
         while True:
@@ -579,18 +587,21 @@ class LLMEngine:
             with self._lock:
                 self.waiting.extendleft(reversed(deferred_long))
         did = False
+        cap = self._prefill_cap
         for bucket, entries in groups.items():
-            try:
-                self._prefill_group(bucket, entries)
-                did = True
-            except Exception:
-                # A bad group must not kill the scheduler thread: fail
-                # the requests, free their pages, keep serving
-                # (SURVEY.md §5.3 pattern).
-                _LOG.exception("prefill failed; failing %d requests",
-                               len(entries))
-                for req, slot_idx, seq, _ in entries:
-                    self._fail_request(req, slot_idx, seq)
+            for start in range(0, len(entries), cap):
+                part = entries[start:start + cap]
+                try:
+                    self._prefill_group(bucket, part)
+                    did = True
+                except Exception:
+                    # A bad group must not kill the scheduler thread:
+                    # fail the requests, free their pages, keep serving
+                    # (SURVEY.md §5.3 pattern).
+                    _LOG.exception("prefill failed; failing %d requests",
+                                   len(part))
+                    for req, slot_idx, seq, _ in part:
+                        self._fail_request(req, slot_idx, seq)
         return did
 
     def _fail_request(self, req: GenRequest, slot_idx: int,

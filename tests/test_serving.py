@@ -455,3 +455,45 @@ class TestChunkedPrefill:
 
         with pytest.raises(PromptTooLongError):
             eng.submit(GenRequest(prompt_ids=list(range(40))))  # > 31
+
+
+class TestPrefillGroupCap:
+    def test_burst_admission_split_into_capped_groups(self, monkeypatch):
+        """max_prefill_group bounds each batched prefill dispatch (the
+        transient-memory cap for large max_batch_size bursts)."""
+        from generativeaiexamples_tpu.serving import engine_model as em
+
+        sizes = []
+        real = em.prefill_batch_step
+
+        def spy(params, cfg, pool, tokens, *a, **k):
+            sizes.append(tokens.shape[0])
+            return real(params, cfg, pool, tokens, *a, **k)
+
+        monkeypatch.setattr(em, "prefill_batch_step", spy)
+        params = llama.init_params(TINY, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch_size=8, max_seq_len=64, page_size=8,
+                            prefill_buckets=(16,), max_prefill_group=2,
+                            decode_steps_per_dispatch=2,
+                            compile_cache_dir="")
+        eng = LLMEngine(params, TINY, ByteTokenizer(), ecfg,
+                        use_pallas=False).start()
+        try:
+            threads = []
+            outs = []
+
+            def run():
+                outs.append(len([e for e in eng.generate_stream(
+                    [3, 4, 5], max_new_tokens=4) if e["token_id"] >= 0]))
+
+            for _ in range(6):
+                t = threading.Thread(target=run)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=60)
+        finally:
+            eng.stop()
+        assert outs == [4] * 6
+        # Groups padded to powers of two but never beyond the cap.
+        assert sizes and max(sizes) <= 2, sizes
