@@ -236,6 +236,10 @@ class ChainServer:
             ok = self.example.delete_documents([filename])
         except NotImplementedError:
             return web.json_response({"detail": "not supported"}, status=405)
+        except ValueError as e:
+            # e.g. the Milvus store rejects names its filter grammar
+            # cannot express — bad client input, not a server fault.
+            return web.json_response({"detail": str(e)}, status=422)
         if not ok:
             return web.json_response({"detail": f"{filename} not found"},
                                      status=404)

@@ -136,8 +136,14 @@ class MilvusVectorStore:
         results = []
         for h in hits:
             score = float(h.get("distance", h.get("score", 0.0)))
-            if score_threshold is not None and score < score_threshold:
-                continue
+            if score_threshold is not None:
+                # IP/COSINE scores are similarities (bigger = better);
+                # L2 is a distance (smaller = better), so the cut flips.
+                if self.metric == "L2":
+                    if score > score_threshold:
+                        continue
+                elif score < score_threshold:
+                    continue
             try:
                 meta = json.loads(h.get("meta") or "{}")
             except (TypeError, json.JSONDecodeError):
@@ -161,11 +167,22 @@ class MilvusVectorStore:
         names = [str(n) for n in filenames]
         if not names:
             return 0
+        # json.dumps escapes quotes/backslashes/control chars in a way the
+        # Milvus filter parser does not understand — reject such names up
+        # front instead of emitting a filter that silently matches nothing.
+        # (ensure_ascii=False below keeps plain non-ASCII names intact.)
+        bad = [n for n in names
+               if '"' in n or "\\" in n or any(ord(c) < 0x20 for c in n)]
+        if bad:
+            raise ValueError(
+                f"filenames containing quotes, backslashes or control "
+                f"characters cannot be used in a Milvus delete filter: "
+                f"{bad!r}")
         # Count the matching rows BEFORE deleting (one filtered query):
         # Milvus applies deletes asynchronously, so a count(*) taken
         # right after the delete may still see the rows and a
         # before/after diff would report 0 for a successful delete.
-        flt = f"filename in {json.dumps(names)}"
+        flt = f"filename in {json.dumps(names, ensure_ascii=False)}"
         probe = self._post("/v2/vectordb/entities/query", {
             "collectionName": self.collection,
             "filter": flt,

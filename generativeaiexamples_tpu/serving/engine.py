@@ -261,8 +261,18 @@ class LLMEngine:
             # block lands, and a full-batch burst can transiently want
             # one sequence more than B x max_pages; exhaustion degrades
             # to requeue/unbatched prefills, so slack is cheap insurance
-            # (one fused 8b page is ~8 MB).
-            n_pages = (self.ecfg.max_batch_size + 1) * self.max_pages + 1
+            # for int8 (one fused 8b page is ~8 MB). A bf16 page at the
+            # same geometry is ~16.7 MB — an extra sequence there costs
+            # ~1 GB HBM at max_seq_len=8192 and can OOM configs that fit
+            # before, so bf16 keeps the tight default and accepts the
+            # degraded mode: in the worst-case transient (slot retired
+            # with all pages parked on an in-flight block, new admission
+            # fills the gap), a decode slot crossing a page boundary can
+            # starve and be finished early with reason "length". Pass
+            # n_pages explicitly to buy the slack back if HBM allows.
+            slack = (self.max_pages
+                     if jnp.dtype(self.ecfg.kv_dtype) == jnp.int8 else 0)
+            n_pages = self.ecfg.max_batch_size * self.max_pages + slack + 1
         kv_sharding = scale_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding

@@ -281,6 +281,12 @@ def paged_attention_int8(
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
+    # The kernel's cross-row prefetch assumes every row owns >= 1 block
+    # (next_block falls through to row b+1 block 0 otherwise, which would
+    # leave the following row consuming a stale buffer). Clamp rather than
+    # assert: a length-0 row attends over one masked page and its output
+    # is ignored by the engine for inactive slots.
+    lengths = jnp.maximum(lengths.astype(jnp.int32), 1)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -289,7 +295,7 @@ def paged_attention_int8(
         # from one grid step to the next.
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-    )(lengths.astype(jnp.int32), page_table.reshape(-1).astype(jnp.int32),
+    )(lengths, page_table.reshape(-1).astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
       jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
       qk, kv_pages, s2)

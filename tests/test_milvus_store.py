@@ -120,6 +120,23 @@ class TestMilvusClient:
                             score_threshold=0.5)
         assert [h.text for h in hits] == ["hi"]
 
+    def test_score_threshold_flips_for_l2(self, stub_server):
+        # With a distance metric smaller is better, so the threshold
+        # keeps the LOW scores (the stub echoes raw scores either way;
+        # only the client-side cut direction is under test).
+        store = MilvusVectorStore(stub_server, dim=2, metric="L2")
+        store.add(["near", "far"], np.asarray([[1, 0], [0.1, 0]], np.float32))
+        hits = store.search(np.asarray([1, 0], np.float32), top_k=4,
+                            score_threshold=0.5)
+        assert [h.text for h in hits] == ["far"]
+
+    def test_delete_rejects_quoted_filenames(self, stub_server):
+        store = MilvusVectorStore(stub_server, dim=2)
+        with pytest.raises(ValueError, match="quotes, backslashes"):
+            store.delete_documents(['evil"name.pdf'])
+        with pytest.raises(ValueError, match="control"):
+            store.delete_documents(["bad\nname.pdf"])
+
     def test_unreachable_server_fails_loudly(self):
         with pytest.raises(MilvusError, match="unreachable"):
             MilvusVectorStore("http://127.0.0.1:9", dim=4, timeout=0.5)
