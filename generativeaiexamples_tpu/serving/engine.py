@@ -22,14 +22,21 @@ concurrency discipline; this one is explicit):
          first token gets a K=1 block so TTFT never rides a full
          K-step block.
 
-  Design note (measured, docs/ENGINEERING_NOTES.md r3): two
-  alternatives that move the blocking fetch off the scheduler — a
-  dedicated reader thread, and is_ready()-polling with
-  copy_to_host_async — both cut loaded admission latency from ~130 ms
-  to ~0.1 ms but cost 9% / 29% steady-state throughput through the
-  axon tunnel (GIL contention / per-block transfer bubbles). On
-  direct-attached hosts where readback is O(100 us) the distinction
-  vanishes, so the simple blocking design stays.
+  Latency design (r4; the r3 study's measured failure modes shaped
+  it): the blocking fetch itself runs on a reader thread that is
+  ENGAGED ONLY while the scheduler is waiting for that one block —
+  steady-state behavior (and throughput) is identical to the
+  measured-fastest blocking design, but during the ~100 ms tunnel
+  readback the scheduler admits new arrivals (prefill dispatches
+  overlap the readback) instead of stalling them (the r3 stage
+  table's 127 ms submit->admit segment). First tokens don't ride
+  block fetches at all: prefill-sampled tokens start a tiny
+  copy_to_host_async at dispatch and are emitted the moment the
+  transfer lands, so TTFT is ~(prefill compute + one RTT) even when
+  older decode blocks are queued for readback. Decode blocks are
+  never dispatched past a request's max_new_tokens (the `scheduled`
+  cap) — overshoot blocks used to hold the next arrival hostage for
+  a readback nobody consumed.
 
 Shapes are always (group, bucket) for prefill and (max_batch,
 max_pages) for decode, padded to power-of-two groups/K-buckets, so
@@ -94,10 +101,20 @@ class _Slot:
         self.span = span  # obs.tracing.ManualSpan or None
         self.last_token: int = 0
         self.generated = 0
+        # Tokens DISPATCHED for this slot (prefill token + K per decode
+        # block it joined), including still-in-flight ones. Lets the
+        # dispatcher cap K so it never launches pure-overshoot blocks
+        # past max_new_tokens — each one used to cost the next arrival a
+        # full ~100 ms readback of a block nobody wanted.
+        self.scheduled = 1
         self.prompt_len = len(req.prompt_ids)
-        # True until the prefill-sampled token has been emitted (it
-        # reaches the host with the first decode block's fetch).
+        # True until this slot has joined its first decode block
+        # (dispatch clears it; drives the K=1 TTFT ramp + first_col).
         self.awaiting_first = True
+        # True once the first token has been EMITTED to the stream —
+        # by the early async-prefill-fetch path or by the first decode
+        # block's col 0, whichever lands first.
+        self.first_emitted = False
         # True while a long prompt's chunked prefill is still running —
         # the slot holds its pages but must not join decode batches.
         self.prefilling = False
@@ -313,6 +330,19 @@ class LLMEngine:
             self._last_tokens = jax.device_put(self._last_tokens,
                                                self._replicated)
         self._inflight: deque = deque()
+        # Prefill-sampled first tokens en route to the host via
+        # copy_to_host_async: [(device_toks, [(slot_idx, slot), ...])].
+        # Emitted the moment the (tiny) transfer lands — TTFT no longer
+        # rides the FIFO queue of full decode-block readbacks.
+        self._pending_first: List = []
+        # Off-thread blocking fetch: the reader thread runs np.asarray
+        # on the oldest in-flight block while the scheduler waits on
+        # _fetch_done, admitting arrivals mid-readback (the ~127 ms
+        # submit->admit stall in the r3 TTFT stage table).
+        self._fetch_req: "queue.Queue" = queue.Queue(maxsize=1)
+        self._fetch_done = threading.Event()
+        self._fetch_box: Dict[str, Any] = {}
+        self._reader: Optional[threading.Thread] = None
         self._long_prefills: List[_LongPrefill] = []
         # Reader beat: landed-decode-block counter; paces chunked
         # prefills to one chunk per block while streams are live.
@@ -322,12 +352,33 @@ class LLMEngine:
         # peak = exactly 1).
         self._max_long_prefills = 1
         self.pipeline_depth = max(1, self.ecfg.pipeline_depth)
+        # K variants precompiled by warmup(); empty (no warmup, e.g.
+        # CPU tests) means any K may dispatch and compile on demand.
+        self._warm_ks: set = set()
+        # Minimum request age before a mid-fetch admission (see
+        # _fetch_block_host). 8 ms batches burst arrivals without
+        # moving the staggered-load TTFT needle.
+        self._admit_debounce_s = float(
+            os.environ.get("ENGINE_ADMIT_DEBOUNCE_MS", "8")) / 1e3
+        # Overlap block readbacks with compute (copy_to_host_async at
+        # dispatch). Off by default pending an end-to-end throughput
+        # measurement on the tunnel (r3's is_ready()-POLLING variant
+        # lost 29%, but that tax was attributed to the polling loop,
+        # not the async copies themselves).
+        self._async_block_copy = (
+            os.environ.get("ENGINE_ASYNC_BLOCK_COPY", "0") == "1")
+        # Scheduler timing log (one line per dispatch/fetch) for perf
+        # decomposition runs; off in production.
+        self._debug_timing = os.environ.get("ENGINE_DEBUG_TIMING", "0") == "1"
+        if self._debug_timing and not logging.getLogger().handlers:
+            logging.basicConfig(level=logging.INFO)
 
     # -- lifecycle ---------------------------------------------------------
 
     def warmup(self, buckets=None, group_sizes=None, ks=None,
                sampled: bool = False,
-               long_prompts: bool = False) -> "LLMEngine":
+               long_prompts: bool = False,
+               long_prompt_lengths=None) -> "LLMEngine":
         """Precompile the prefill/decode graph variants BEFORE serving.
 
         Admission pads prefill groups to powers of two and decode blocks
@@ -357,7 +408,14 @@ class LLMEngine:
             k_live = max(1, self.ecfg.decode_steps_per_dispatch)
             while k_live & (k_live - 1):
                 k_live &= k_live - 1
-            ks = sorted({1, k_live})
+            # 2 is the low-occupancy block size (see _dispatch_decode).
+            ks = sorted({1, 2, k_live})
+        # The dispatcher will never pick a K outside this set while it
+        # is non-empty — a cold decode variant compiling mid-traffic
+        # freezes every live stream for 20-40 s. K=1 is forced in so a
+        # warmed variant exists under ANY hard bound (page capacity).
+        ks = sorted(set(ks) | {1})
+        self._warm_ks = set(ks)
         flag_sets = [(True, False, False)]
         if sampled:
             flag_sets.append((False, True, True))
@@ -393,12 +451,19 @@ class LLMEngine:
             # Chunked-prefill variants: one scratch-cache shape per
             # chunk multiple up to page capacity (a cold S_total would
             # otherwise compile on the scheduler thread mid-traffic,
-            # freezing live streams).
+            # freezing live streams). `long_prompt_lengths` restricts
+            # warming to known serving lengths — each variant is its
+            # own 20-40 s compile on a cold cache.
             from generativeaiexamples_tpu.models.llama import KVCache
 
             chunk = self.buckets[-1]
-            s_tot = chunk
-            while s_tot <= self.max_pages * ps:
+            if long_prompt_lengths is not None:
+                s_tots = sorted({min(-(-int(s) // chunk) * chunk,
+                                     self.max_pages * ps)
+                                 for s in long_prompt_lengths})
+            else:
+                s_tots = list(range(chunk, self.max_pages * ps + 1, chunk))
+            for s_tot in s_tots:
                 cache = KVCache.zeros(self.cfg, 1, max_len=s_tot)
                 cache = self._place_scratch_cache(cache)
                 _, cache = engine_model.prefill_chunk_step(
@@ -409,7 +474,6 @@ class LLMEngine:
                 self.pool = engine_model.cache_to_pool(
                     self.pool, cache, self.cfg,
                     self._put(np.zeros((s_tot // ps,), np.int32)))
-                s_tot += chunk
         jax.block_until_ready(self._last_tokens)
         _LOG.info("engine warmup: %d prefill + %d decode variants compiled",
                   len(self.buckets if buckets is None else buckets)
@@ -419,6 +483,9 @@ class LLMEngine:
 
     def start(self) -> "LLMEngine":
         self._running = True
+        self._reader = threading.Thread(target=self._reader_loop,
+                                        daemon=True, name="llm-engine-read")
+        self._reader.start()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-engine")
         self._thread.start()
@@ -429,6 +496,9 @@ class LLMEngine:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._reader:
+            self._reader.join(timeout=10)
+            self._reader = None
 
     # -- public API --------------------------------------------------------
 
@@ -502,6 +572,7 @@ class LLMEngine:
             # by the landed-block beat) instead of monopolizing the
             # device queue.
             did_work = self._advance_long_prefills() or did_work
+            self._emit_ready_first_tokens()
             # Keep the dispatch pipeline full.
             while (len(self._inflight) < self.pipeline_depth
                    and any(s is not None for s in self.slots)):
@@ -519,7 +590,7 @@ class LLMEngine:
             if self._inflight:
                 fl = self._inflight.popleft()
                 try:
-                    self._process_block_host(fl, np.asarray(fl.block))
+                    self._process_block_host(fl, self._fetch_block_host(fl))
                 except Exception:
                     _LOG.exception("decode block failed; failing batch")
                     self._fail_active()
@@ -533,9 +604,113 @@ class LLMEngine:
                 self._reap_starved()
                 self._beat += 1
                 did_work = True
+            elif self._pending_first:
+                # No blocks in flight but first tokens still en route
+                # (e.g. every active request finished at its first
+                # token): poll rather than sleep the full timeout.
+                self._wake.wait(timeout=0.002)
+                self._wake.clear()
+                continue
             if not did_work:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+
+    def _reader_loop(self) -> None:
+        """Blocking host readbacks, off the scheduler thread. Engaged
+        only when the scheduler hands over a block (one at a time), so
+        steady state is identical to the measured-fastest blocking
+        design (ENGINEERING_NOTES r3 scheduler study) — the GIL cost of
+        a free-running reader never materializes — while the scheduler
+        stays responsive to admissions during the ~100 ms readback."""
+        while self._running:
+            try:
+                blk = self._fetch_req.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            box: Dict[str, Any] = {}
+            try:
+                box["host"] = np.asarray(blk)
+            except Exception as e:  # surfaced on the scheduler thread
+                box["err"] = e
+            self._fetch_box = box
+            self._fetch_done.set()
+
+    def _fetch_block_host(self, fl: _InFlight) -> np.ndarray:
+        """Fetch one in-flight block to the host. The wait happens on
+        the reader thread; while it runs, the scheduler admits newly
+        arrived requests (their prefill dispatches overlap the
+        readback) and emits first tokens whose async copies landed —
+        the two latency paths that used to wait out the fetch."""
+        if self._reader is None or not self._reader.is_alive():
+            return np.asarray(fl.block)  # tests may drive _loop inline
+        t0 = time.perf_counter() if self._debug_timing else 0.0
+        self._fetch_done.clear()
+        self._fetch_req.put(fl.block)
+        while not self._fetch_done.wait(timeout=0.005):
+            if not self._running or not self._reader.is_alive():
+                # stop() raced the handoff. If the reader exited without
+                # consuming the block, reclaim it and fetch inline;
+                # if it did consume, give it a bounded grace period.
+                try:
+                    self._fetch_req.get_nowait()
+                except queue.Empty:
+                    if self._fetch_done.wait(timeout=10):
+                        break
+                return np.asarray(fl.block)
+            self._emit_ready_first_tokens()
+            # Mid-fetch admissions: only once the oldest arrival has
+            # aged past a short debounce, so a burst batches into few
+            # large prefill groups (one weight read per group) instead
+            # of one group per 5 ms poll. Costs at most the debounce in
+            # TTFT under load; idle-path admission stays immediate.
+            with self._lock:
+                oldest = (self.waiting[0].submit_time if self.waiting
+                          else None)
+            if oldest is not None and \
+                    time.perf_counter() - oldest >= self._admit_debounce_s:
+                self._admit_waiting()
+        box, self._fetch_box = self._fetch_box, {}
+        if self._debug_timing:
+            _LOG.info("[timing] fetch K=%d %.1fms inflight=%d",
+                      fl.K, (time.perf_counter() - t0) * 1e3,
+                      len(self._inflight))
+        if "err" in box:
+            raise box["err"]
+        return box["host"]
+
+    def _emit_ready_first_tokens(self) -> None:
+        """Emit first tokens whose prefill-sampled values have reached
+        the host (async copy issued at prefill dispatch). Slots whose
+        first decode block was processed first (first_emitted set there)
+        are simply dropped — the token values are identical because
+        decode blocks chain from the same device buffer."""
+        for item in list(self._pending_first):
+            toks, metas = item
+            if all(slot.first_emitted or self.slots[i] is not slot
+                   for i, slot in metas):
+                self._pending_first.remove(item)
+                continue
+            try:
+                if not toks.is_ready():
+                    continue
+            except AttributeError:
+                pass  # non-jax array (tests): treat as ready
+            vals = np.asarray(toks).reshape(-1)
+            self._pending_first.remove(item)
+            now = time.perf_counter()
+            for j, (slot_idx, slot) in enumerate(metas):
+                if self.slots[slot_idx] is not slot or slot.first_emitted:
+                    continue
+                slot.first_emitted = True
+                ttft_ms = (now - slot.req.submit_time) * 1e3
+                self.metrics.record_ttft(ttft_ms)
+                if slot.span is not None:
+                    slot.span.add_event("first_token",
+                                        {"ttft_ms": round(ttft_ms, 2)})
+                tok = int(vals[j])
+                slot.last_token = tok
+                self._emit(slot, tok, slot_idx=slot_idx)
+                self.metrics.record_tokens(1)
 
     @property
     def _prefill_cap(self) -> int:
@@ -664,6 +839,9 @@ class LLMEngine:
             idxs[j] = slot_idx
         all_greedy = bool(all(temps[:n] <= 0.0))
         flags = (True, False, False) if all_greedy else (False, True, True)
+        if self._debug_timing:
+            _LOG.info("[timing] prefill bucket=%d n=%d padded=%d",
+                      bucket, n, N)
         toks, self.pool = engine_model.prefill_batch_step(
             self.params, self.cfg, self.pool, self._put(tokens),
             self._put(lengths), self._put(rows), self._put(temps),
@@ -673,6 +851,7 @@ class LLMEngine:
         # out-of-bounds indices are dropped on device).
         self._last_tokens = engine_model.set_last_tokens(
             self._last_tokens, self._put(idxs), toks)
+        metas = []
         for req, slot_idx, seq, ids in entries:
             span = ManualSpan("engine.generate", context=req.trace_context,
                               attributes={"prompt_tokens": len(ids),
@@ -680,6 +859,16 @@ class LLMEngine:
             slot = _Slot(req, seq, StreamDetokenizer(self.tokenizer),
                          span=span)
             self.slots[slot_idx] = slot
+            metas.append((slot_idx, slot))
+        # Start the (tiny, [N] int32) first-token transfer NOW: it rides
+        # the tunnel concurrently with in-flight block readbacks, so the
+        # first token reaches the stream ~one prefill + one RTT after
+        # submit instead of queueing behind every older block fetch.
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._pending_first.append((toks, metas))
 
     def _begin_long_prefill(self, req: GenRequest, slot_idx: int,
                             seq: SequencePages, ids: List[int],
@@ -775,9 +964,15 @@ class LLMEngine:
                           attributes={"prompt_tokens": len(lp.ids),
                                       "chunked_prefill": True,
                                       "request_id": req.request_id})
-        self.slots[lp.slot_idx] = _Slot(req, lp.seq,
-                                        StreamDetokenizer(self.tokenizer),
-                                        span=span)
+        slot = _Slot(req, lp.seq, StreamDetokenizer(self.tokenizer),
+                     span=span)
+        self.slots[lp.slot_idx] = slot
+        # Same early first-token path as bucketed prefill.
+        try:
+            tok0.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._pending_first.append((tok0, [(lp.slot_idx, slot)]))
 
     def _place_scratch_cache(self, cache):
         """Shard a chunked-prefill scratch cache like the KV pool (kv
@@ -802,15 +997,11 @@ class LLMEngine:
         later by _process_block."""
         B = len(self.slots)
         K = max(1, self.ecfg.decode_steps_per_dispatch)
-        # TTFT ramp: a slot waiting for its first token gets a K=1 block
-        # (its token reaches the host one small block sooner instead of
-        # riding a full K-step block). Steady state has no awaiting
-        # slots, so sustained throughput is unaffected; during arrival
-        # churn this trades a sliver of batch efficiency for ~K fewer
-        # token-times of TTFT queueing.
-        if any(s is not None and s.awaiting_first and not s.prefilling
-               for s in self.slots):
-            K = 1
+        # (r3 had a K=1 "TTFT ramp" for slots awaiting their first
+        # token. Gone in r4: first tokens are emitted off the async
+        # prefill copy, never off a decode-block fetch, so shrinking
+        # the block bought nothing and fragmented the burst into
+        # one-token-per-weight-read blocks during arrival churn.)
         lengths = np.ones((B,), np.int32)
         tables = np.zeros((B, self.max_pages), np.int32)
         temps = np.zeros((B,), np.float32)
@@ -830,14 +1021,43 @@ class LLMEngine:
             if cap < 1:
                 self._starve(i)
                 continue
+            if s.req.max_new_tokens - s.scheduled <= 0:
+                # Every token this request asked for is already emitted
+                # or in flight — another block would be pure overshoot
+                # (device work + a ~100 ms readback nobody consumes; it
+                # also made bench's back-to-back single-request TTFT
+                # read ~150 ms above the breakdown instrument, r3).
+                continue
             live.append(i)
         if not live:
             return False
-        # Shared fused-step count: bounded by every slot's page capacity,
-        # bucketed to powers of two so only log2(K) shapes ever compile.
+        if len(live) * 4 <= B:
+            # Low-occupancy (arrival-heavy) regime: short blocks keep
+            # the device queue shallow, so a new arrival's prefill is
+            # never stuck behind ~K full weight reads of mostly-empty
+            # decode work (staggered-load TTFT target <=200 ms). At
+            # high occupancy the K=8 blocks that maximize throughput
+            # return; per-token device cost is identical either way —
+            # K only amortizes fetches, which overlap compute anyway.
+            K = min(K, 2)
+        # Shared fused-step count. Two caps with different semantics:
+        # page capacity is HARD (steps past it write out of bounds) —
+        # round DOWN; the token budget is SOFT (steps past the last
+        # requested token are dropped at emission) — round UP to the
+        # nearest precompiled K rather than shrink onto a cold variant
+        # that would freeze every stream behind a 20-40 s compile.
         cap_steps = min(self.max_pages * self.pool.page_size
                         - self.slots[i].seq.length for i in live)
-        K = min(K, max(1, cap_steps))
+        max_rem = max(self.slots[i].req.max_new_tokens
+                      - self.slots[i].scheduled for i in live)
+        K = self._pick_k(min(K, max(1, cap_steps)))
+        if max_rem < K:
+            if self._warm_ks:
+                fits = sorted(k for k in self._warm_ks
+                              if max_rem <= k <= K)
+                K = fits[0] if fits else K
+            else:
+                K = self._pick_k(max(1, max_rem))
         while K & (K - 1):
             K &= K - 1
         active: List[int] = []
@@ -877,9 +1097,7 @@ class LLMEngine:
                 top_ks[i] = s.req.top_k
             if shrink_to is None:
                 break
-            K = shrink_to
-            while K & (K - 1):  # power-of-two bucket, rounding down
-                K &= K - 1
+            K = self._pick_k(shrink_to)
         if not active:
             return False
         # Static sampling flags from host-known params: a fully greedy
@@ -901,10 +1119,34 @@ class LLMEngine:
             s = self.slots[i]
             metas.append((i, s, 0 if s.awaiting_first else 1))
             s.awaiting_first = False
+            s.scheduled += K
         self.metrics.decode_steps += K
         self.metrics.busy_slots_acc += len(active) * K
+        if self._async_block_copy:
+            # Start the [B, K+1] readback as soon as the block is
+            # dispatched: transfers overlap newer blocks' compute, so
+            # the later blocking fetch finds the bytes already landed
+            # (or in flight) instead of paying the full tunnel RTT.
+            try:
+                block.copy_to_host_async()
+            except AttributeError:
+                pass
         self._inflight.append(_InFlight(block, metas, K))
         return True
+
+    def _pick_k(self, bound: int) -> int:
+        """Largest dispatchable K <= bound: power-of-two, and (when a
+        warmup ran) restricted to the precompiled variants. K=1 always
+        exists as a shape (it is forced into every warmup ks set), so
+        the invariant "no cold K mid-traffic" holds even when the bound
+        is below every warmed variant."""
+        k = max(1, bound)
+        while k & (k - 1):
+            k &= k - 1
+        if self._warm_ks and k not in self._warm_ks:
+            # Non-empty: warmup() forces 1 into the set, and k >= 1.
+            k = max(w for w in self._warm_ks if w <= k)
+        return k
 
     def _starve(self, slot_idx: int) -> None:
         """The dispatcher can't advance this slot. If blocks are still in
@@ -939,13 +1181,19 @@ class LLMEngine:
             if self.slots[i] is not slot:
                 continue  # retired while this block was in flight
             if first_col == 0:
-                # The slot's very first token (sampled at prefill) lands
-                # with this fetch — this is the honest TTFT.
-                ttft_ms = (now - slot.req.submit_time) * 1e3
-                self.metrics.record_ttft(ttft_ms)
-                if slot.span is not None:
-                    slot.span.add_event("first_token",
-                                        {"ttft_ms": round(ttft_ms, 2)})
+                if slot.first_emitted:
+                    # The early async-fetch path already emitted col 0's
+                    # value (same device buffer); skip the duplicate.
+                    first_col = 1
+                else:
+                    # The slot's very first token (sampled at prefill)
+                    # lands with this fetch — this is the honest TTFT.
+                    slot.first_emitted = True
+                    ttft_ms = (now - slot.req.submit_time) * 1e3
+                    self.metrics.record_ttft(ttft_ms)
+                    if slot.span is not None:
+                        slot.span.add_event("first_token",
+                                            {"ttft_ms": round(ttft_ms, 2)})
             for j in range(first_col, fl.K + 1):
                 tok = int(block[i, j])
                 slot.last_token = tok

@@ -64,6 +64,7 @@ class PlaygroundServer:
             web.delete("/api/documents", self.handle_delete),
             web.get("/api/voice", self.handle_voice_caps),
             web.post("/api/transcribe", self.handle_transcribe),
+            web.get("/api/transcribe/ws", self.handle_transcribe_ws),
             web.post("/api/speech", self.handle_speech),
             web.post("/api/feedback", self.handle_feedback),
         ])
@@ -184,6 +185,98 @@ class PlaygroundServer:
                                      status=422)
         text = await asyncio.to_thread(self.asr.transcribe, pcm, rate)
         return web.json_response({"text": text})
+
+    async def handle_transcribe_ws(self, request: web.Request
+                                   ) -> web.WebSocketResponse:
+        """Streaming transcription with INTERIM results (reference
+        parity: Riva's interim_results=True partial transcripts while
+        the user speaks, frontend/asr_utils.py:120-152).
+
+        Protocol: client opens the socket, sends one JSON text frame
+        {"rate": <sample_rate>}, then binary frames of raw mono int16
+        little-endian PCM as recorded. The server re-transcribes the
+        ACCUMULATED audio (throttled to one in-flight interim request,
+        min `interim_s` apart) and pushes {"text", "final": false}
+        after each pass; on {"end": true} it transcribes the complete
+        take once more and replies {"text", "final": true}. Works with
+        any batch ASR endpoint behind the seam — no streaming ASR API
+        required."""
+        ws = web.WebSocketResponse(max_msg_size=16 * 1024 * 1024)
+        await ws.prepare(request)
+        if self.asr is None:
+            await ws.send_json({"error": "no ASR endpoint configured"})
+            await ws.close()
+            return ws
+        import time as _time
+
+        rate = self.voice_sample_rate
+        buf: list = []
+        n_at_last = 0
+        interim_s = float(os.environ.get("VOICE_INTERIM_INTERVAL_S", "0.5"))
+        last_interim = 0.0
+        interim_task: "asyncio.Task | None" = None
+
+        def _pcm():
+            import numpy as np
+
+            return (np.concatenate(buf) if buf
+                    else np.zeros((0,), "int16"))
+
+        async def send_interim(snapshot):
+            try:
+                text = await asyncio.to_thread(self.asr.transcribe,
+                                               snapshot, rate)
+                if text and not ws.closed:
+                    await ws.send_json({"text": text, "final": False})
+            except Exception:  # interim results are best-effort
+                _LOG.debug("interim transcription failed", exc_info=True)
+
+        async for msg in ws:
+            if msg.type == web.WSMsgType.BINARY:
+                import numpy as np
+
+                if len(msg.data) % 2:
+                    await ws.send_json(
+                        {"error": "binary frames must be int16 PCM "
+                                  "(even byte length)"})
+                    continue
+                buf.append(np.frombuffer(msg.data, "<i2"))
+                now = _time.monotonic()
+                grown = sum(len(c) for c in buf) > n_at_last
+                if (grown and now - last_interim >= interim_s
+                        and (interim_task is None or interim_task.done())):
+                    last_interim = now
+                    n_at_last = sum(len(c) for c in buf)
+                    interim_task = asyncio.create_task(
+                        send_interim(_pcm()))
+            elif msg.type == web.WSMsgType.TEXT:
+                try:
+                    data = json.loads(msg.data)
+                except json.JSONDecodeError:
+                    continue
+                if "rate" in data:
+                    rate = int(data["rate"])
+                if data.get("end"):
+                    if interim_task is not None:
+                        interim_task.cancel()
+                    try:
+                        text = await asyncio.to_thread(self.asr.transcribe,
+                                                       _pcm(), rate)
+                        await ws.send_json({"text": text, "final": True})
+                    except Exception as e:
+                        # A failed final must reach the client as an
+                        # error frame, not a bare close — the page falls
+                        # back to the one-shot WAV POST with the take it
+                        # still has buffered.
+                        _LOG.warning("final transcription failed: %s", e)
+                        if not ws.closed:
+                            await ws.send_json(
+                                {"error": f"transcription failed: {e}"})
+                    break
+            elif msg.type in (web.WSMsgType.ERROR, web.WSMsgType.CLOSE):
+                break
+        await ws.close()
+        return ws
 
     async def handle_speech(self, request: web.Request) -> web.Response:
         """{"text": ...} -> WAV bytes (audio/wav)."""

@@ -144,6 +144,15 @@ function pcm16Wav(samples, rate) {
   return new Blob([buf], { type: "audio/wav" });
 }
 
+function toInt16(f32) {
+  const out = new Int16Array(f32.length);
+  for (let i = 0; i < f32.length; i++) {
+    const s = Math.max(-1, Math.min(1, f32[i]));
+    out[i] = s < 0 ? s * 0x8000 : s * 0x7fff;
+  }
+  return out;
+}
+
 let rec = null;
 async function startRec() {
   const stream = await navigator.mediaDevices.getUserMedia({ audio: true });
@@ -151,19 +160,38 @@ async function startRec() {
   const src = ctx.createMediaStreamSource(stream);
   const proc = ctx.createScriptProcessor(4096, 1, 1);
   const chunks = [];
-  proc.onaudioprocess = (e) => chunks.push(new Float32Array(e.inputBuffer.getChannelData(0)));
+  // Interim transcripts while speaking (reference parity: Riva
+  // interim_results): stream PCM over a websocket; partial text lands
+  // in the input box live, the final transcript submits the form.
+  let ws = null;
+  try {
+    const proto = location.protocol === "https:" ? "wss:" : "ws:";
+    ws = new WebSocket(`${proto}//${location.host}/api/transcribe/ws`);
+    ws.binaryType = "arraybuffer";
+    ws.onopen = () => ws.send(JSON.stringify({ rate: ctx.sampleRate }));
+    ws.onmessage = (ev) => {
+      const out = JSON.parse(ev.data);
+      if (out.text) {
+        input.value = out.text;
+        input.classList.toggle("interim", !out.final);
+        if (out.final) form.requestSubmit();
+      }
+    };
+    ws.onerror = () => { ws = null; };
+  } catch (e) { ws = null; }
+  proc.onaudioprocess = (e) => {
+    const f32 = new Float32Array(e.inputBuffer.getChannelData(0));
+    chunks.push(f32);
+    if (ws && ws.readyState === WebSocket.OPEN) ws.send(toInt16(f32).buffer);
+  };
   src.connect(proc); proc.connect(ctx.destination);
-  rec = { stream, ctx, proc, chunks };
+  rec = { stream, ctx, proc, chunks, ws };
   micBtn.classList.add("recording");
 }
 
-async function stopRec() {
-  if (!rec) return;
-  const { stream, ctx, proc, chunks } = rec;
-  rec = null;
-  micBtn.classList.remove("recording");
-  proc.disconnect(); stream.getTracks().forEach((t) => t.stop());
-  const rate = ctx.sampleRate; await ctx.close();
+async function postTake(chunks, rate) {
+  // One-shot WAV POST of the buffered take (no websocket, or the
+  // websocket died before delivering a final transcript).
   const n = chunks.reduce((a, c) => a + c.length, 0);
   const all = new Float32Array(n);
   let o = 0; for (const c of chunks) { all.set(c, o); o += c.length; }
@@ -175,6 +203,49 @@ async function stopRec() {
     const out = await resp.json();
     if (out.text) { input.value = out.text; form.requestSubmit(); }
   }
+}
+
+async function stopRec() {
+  if (!rec) return;
+  const { stream, ctx, proc, chunks } = rec;
+  let ws = rec.ws;
+  rec = null;
+  micBtn.classList.remove("recording");
+  proc.disconnect(); stream.getTracks().forEach((t) => t.stop());
+  const rate = ctx.sampleRate; await ctx.close();
+  if (ws && ws.readyState === WebSocket.CONNECTING) {
+    // Quick tap: the handshake never completed. Close it (also frees
+    // the server-side handler) and use the POST path.
+    try { ws.close(); } catch (e) { /* already dead */ }
+    ws = null;
+  }
+  if (ws && ws.readyState === WebSocket.OPEN) {
+    // The final transcript normally lands via onmessage; if the socket
+    // errors, closes, or times out without one, the buffered take is
+    // still in hand — recover through the POST path instead of
+    // silently discarding the recording.
+    let settled = false;
+    const fallback = () => {
+      if (settled) return;
+      settled = true;
+      input.classList.remove("interim");
+      postTake(chunks, rate);
+    };
+    const prevHandler = ws.onmessage;
+    ws.onmessage = (ev) => {
+      if (settled) return;  // fallback already submitted this take
+      const out = JSON.parse(ev.data);
+      if (out.error) { fallback(); ws.close(); return; }
+      if (out.final) settled = true;
+      prevHandler(ev);
+    };
+    ws.onclose = fallback;
+    ws.onerror = fallback;
+    setTimeout(fallback, 15000);
+    ws.send(JSON.stringify({ end: true }));
+    return;
+  }
+  await postTake(chunks, rate);
 }
 
 // Pointer events cover mouse AND touch (hold-to-talk on phones).

@@ -160,7 +160,21 @@ class TestFactorySelection:
         store = create_vector_store(cfg, dim=4)
         assert isinstance(store, MilvusVectorStore)
 
-    def test_pgvector_rejected_with_clear_error(self, default_config):
+    def test_pgvector_without_url_fails_loudly(self, default_config):
+        import dataclasses
+
+        from generativeaiexamples_tpu.rag.pgvector_store import PgError
+        from generativeaiexamples_tpu.rag.vectorstore import (
+            create_vector_store)
+
+        cfg = dataclasses.replace(
+            default_config,
+            vector_store=dataclasses.replace(
+                default_config.vector_store, name="pgvector"))
+        with pytest.raises(PgError, match="requires vector_store.url"):
+            create_vector_store(cfg, dim=4)
+
+    def test_unknown_store_rejected_with_clear_error(self, default_config):
         import dataclasses
 
         from generativeaiexamples_tpu.rag.vectorstore import (
@@ -169,8 +183,8 @@ class TestFactorySelection:
         cfg = dataclasses.replace(
             default_config,
             vector_store=dataclasses.replace(
-                default_config.vector_store, name="pgvector"))
-        with pytest.raises(ValueError, match="pgvector"):
+                default_config.vector_store, name="faiss"))
+        with pytest.raises(ValueError, match="not a bundled store"):
             create_vector_store(cfg, dim=4)
 
 

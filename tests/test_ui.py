@@ -189,6 +189,84 @@ def test_playground_voice_round_trip(tmp_path):
     asyncio.run(body(tmp_path))
 
 
+def test_streaming_transcription_interim_results(tmp_path, monkeypatch):
+    """Websocket mic path: partial transcripts arrive WHILE audio is
+    still streaming (>=2 interim updates before the final), then the
+    end marker yields the full-take transcript — the reference's Riva
+    interim_results=True behavior (asr_utils.py:120-152) through the
+    batch-ASR seam."""
+    import numpy as np
+
+    from generativeaiexamples_tpu.streaming.asr import FakeASR
+
+    # No throttle gap in tests: every chunk may trigger an interim pass.
+    monkeypatch.setenv("VOICE_INTERIM_INTERVAL_S", "0")
+
+    async def body(tmp_path):
+        chain = _make_chain(tmp_path)
+        chain_srv = TestServer(chain.app)
+        await chain_srv.start_server()
+        client = ChatClient(f"http://{chain_srv.host}:{chain_srv.port}",
+                            "test-model")
+        asr = FakeASR(script=["what", "what is", "what is a",
+                              "what is a tpu"])
+        ui = TestClient(TestServer(PlaygroundServer(client, asr=asr).app))
+        await ui.start_server()
+        try:
+            ws = await ui.ws_connect("/api/transcribe/ws")
+            await ws.send_json({"rate": 16000})
+            tone = (np.sin(np.arange(8000) / 10) * 8000).astype("<i2")
+            got = []
+            # Stream chunks, reading any interim messages as they come.
+            for _ in range(3):
+                await ws.send_bytes(tone.tobytes())
+                # Give the interim task a beat to transcribe + push.
+                for _ in range(50):
+                    try:
+                        msg = await ws.receive_json(timeout=0.05)
+                        got.append(msg)
+                        break
+                    except asyncio.TimeoutError:
+                        await asyncio.sleep(0)
+            await ws.send_json({"end": True})
+            while not (got and got[-1].get("final")):
+                got.append(await ws.receive_json(timeout=5))
+            await ws.close()
+            interim = [m for m in got if not m.get("final")]
+            final = [m for m in got if m.get("final")]
+            assert len(interim) >= 2, got
+            assert len(final) == 1
+            assert final[0]["text"].startswith("what")
+            # Interim passes each saw the ACCUMULATED take so far.
+            assert all(m["text"].startswith("what") for m in interim)
+        finally:
+            await ui.close()
+            await chain_srv.close()
+
+    asyncio.run(body(tmp_path))
+
+
+def test_streaming_transcription_unconfigured(tmp_path):
+    async def body(tmp_path):
+        chain = _make_chain(tmp_path)
+        chain_srv = TestServer(chain.app)
+        await chain_srv.start_server()
+        client = ChatClient(f"http://{chain_srv.host}:{chain_srv.port}",
+                            "test-model")
+        ui = TestClient(TestServer(PlaygroundServer(client).app))
+        await ui.start_server()
+        try:
+            ws = await ui.ws_connect("/api/transcribe/ws")
+            msg = await ws.receive_json(timeout=5)
+            assert "error" in msg
+            await ws.close()
+        finally:
+            await ui.close()
+            await chain_srv.close()
+
+    asyncio.run(body(tmp_path))
+
+
 def test_playground_voice_unconfigured_501(tmp_path):
     async def body(tmp_path):
         chain = _make_chain(tmp_path)
