@@ -289,11 +289,8 @@ def _bench_longctx(params, cfg):
         return {"longctx_skipped":
                 f"model geometry too small (max_seq_len={cfg.max_seq_len})"}
     # 8192 = the model's rope table; prompts stop a page short so the
-    # generated tokens stay in range. B=4: the prefill step currently
-    # materializes one full pool copy on this backend (XLA remat of the
-    # donated pool), so pool bytes must fit TWICE beside 8 GB weights —
-    # 2.5 GB at B=4 does, 4.5 GB at B=8 OOMs.
-    ecfg = EngineConfig(max_batch_size=4, max_seq_len=8192, page_size=128,
+    # generated tokens stay in range.
+    ecfg = EngineConfig(max_batch_size=8, max_seq_len=8192, page_size=128,
                         prefill_buckets=(1024,), kv_dtype="int8",
                         decode_steps_per_dispatch=8, pipeline_depth=2)
     eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg)

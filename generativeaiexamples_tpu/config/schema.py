@@ -204,6 +204,13 @@ class EngineConfig:
     # fetch so device compute overlaps result readback (readback latency
     # is ~100 ms through the axon tunnel). 1 = synchronous (old behavior).
     pipeline_depth: int = 2
+    # Greedy self-speculative decoding: draft k tokens per step from an
+    # on-device n-gram history lookup and verify them in ONE forward —
+    # up to k+1 tokens per weight read (the NIM/TRT-LLM speculative-
+    # decoding role). 0 = off. Greedy-only: a speculative engine
+    # rejects sampled requests at submit; emitted tokens are always
+    # exactly the greedy continuation regardless of acceptance.
+    speculative_k: int = 0
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
 

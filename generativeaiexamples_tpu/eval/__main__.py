@@ -80,6 +80,10 @@ def main() -> int:
             qa_rows = json.load(fh)
         assert all("question" in r and "answer" in r for r in qa_rows), \
             "--qa-file rows need question + answer"
+        # The metric suite reads the reference answer under the
+        # harness's row key (ground_truth_answer).
+        qa_rows = [{**r, "ground_truth_answer": r.get(
+            "ground_truth_answer", r["answer"])} for r in qa_rows]
         _LOG.info("loaded %d QA pairs from %s", len(qa_rows), args.qa_file)
     else:
         splitter = get_text_splitter(cfg)

@@ -30,9 +30,15 @@ _YES_RE = re.compile(r"\b(yes|true|1)\b", re.I)
 
 
 def _ask_binary(llm, prompt: str) -> Optional[float]:
-    """LLM yes/no probe -> 1.0/0.0 (None on unparseable)."""
-    out = llm.chat([{"role": "user", "content": prompt}], max_tokens=8,
-                   temperature=0.0)
+    """LLM yes/no probe -> 1.0/0.0 (None on unparseable or on a failed
+    call — one bad probe must not null out the whole metric run)."""
+    try:
+        out = llm.chat([{"role": "user", "content": prompt}], max_tokens=8,
+                       temperature=0.0)
+    except Exception as e:
+        _LOG.warning("binary probe failed (%s): %s",
+                     type(e).__name__, str(e)[:200])
+        return None
     if _YES_RE.search(out):
         return 1.0
     if re.search(r"\b(no|false|0)\b", out, re.I):

@@ -83,6 +83,12 @@ class EmbeddingEngine:
         out = np.zeros((len(ids), self.cfg.dim), np.float32)
         order = sorted(range(len(ids)), key=lambda i: len(ids[i]))
         with self._lock:
+            # Dispatch every batch asynchronously FIRST, then drain:
+            # a fetch through the axon tunnel costs ~100-130 ms RTT, so
+            # fetching inside the dispatch loop serialized readbacks
+            # with compute (the r3 decomposition's dominant term —
+            # ~2x the docs/s once overlapped).
+            pending = []
             for start in range(0, len(order), self.max_batch):
                 chunk = order[start: start + self.max_batch]
                 S = _bucket(max(len(ids[i]) for i in chunk) or 1, self.buckets)
@@ -92,8 +98,15 @@ class EmbeddingEngine:
                     n = max(1, len(ids[i]))
                     toks[row, : len(ids[i])] = ids[i]
                     lens[row] = n
-                vecs = np.asarray(self._fwd(self.params, jnp.asarray(toks),
-                                            jnp.asarray(lens)))
+                vecs_dev = self._fwd(self.params, jnp.asarray(toks),
+                                     jnp.asarray(lens))
+                try:
+                    vecs_dev.copy_to_host_async()
+                except AttributeError:
+                    pass
+                pending.append((vecs_dev, chunk))
+            for vecs_dev, chunk in pending:
+                vecs = np.asarray(vecs_dev)
                 for row, i in enumerate(chunk):
                     out[i] = vecs[row]
         return out
@@ -139,6 +152,8 @@ class RerankEngine:
             pairs.append((head + tail, len(head)))
         out = np.zeros((len(pairs),), np.float32)
         with self._lock:
+            # Same dispatch-all-then-drain overlap as EmbeddingEngine.
+            pending = []
             for start in range(0, len(pairs), self.max_batch):
                 chunk = pairs[start: start + self.max_batch]
                 S = _bucket(max(len(c[0]) for c in chunk) or 1, self.buckets)
@@ -149,8 +164,14 @@ class RerankEngine:
                     toks[row, : len(ids)] = ids
                     lens[row] = max(1, len(ids))
                     types[row, sep: len(ids)] = 1  # segment B = passage
-                scores = np.asarray(self._fwd(self.params, jnp.asarray(toks),
-                                              jnp.asarray(lens),
-                                              jnp.asarray(types)))
-                out[start: start + len(chunk)] = scores[: len(chunk), 0]
+                scores_dev = self._fwd(self.params, jnp.asarray(toks),
+                                       jnp.asarray(lens), jnp.asarray(types))
+                try:
+                    scores_dev.copy_to_host_async()
+                except AttributeError:
+                    pass
+                pending.append((scores_dev, start, len(chunk)))
+            for scores_dev, start, n in pending:
+                scores = np.asarray(scores_dev)
+                out[start: start + n] = scores[:n, 0]
         return out

@@ -68,6 +68,14 @@ from generativeaiexamples_tpu.utils.tokenizer import StreamDetokenizer
 _LOG = logging.getLogger(__name__)
 
 
+def _to_host(blk):
+    """Device block -> host numpy; speculative blocks are
+    (targets, counts) tuples."""
+    if isinstance(blk, tuple):
+        return tuple(np.asarray(b) for b in blk)
+    return np.asarray(blk)
+
+
 class PromptTooLongError(ValueError):
     """Prompt exceeds the engine's page capacity (prompts beyond the
     largest prefill bucket go through chunked prefill, so the cap is
@@ -115,6 +123,13 @@ class _Slot:
         # by the early async-prefill-fetch path or by the first decode
         # block's col 0, whichever lands first.
         self.first_emitted = False
+        # Speculative bookkeeping: kv_len = tokens whose KV is KNOWN
+        # stored (reconciled at block landing); kv_worst = worst-case
+        # tokens of still-in-flight spec blocks. Page allocation must
+        # cover kv_len + kv_worst — reconciling against only the block
+        # that just landed under-allocates for its pipelined sibling.
+        self.kv_len = self.prompt_len
+        self.kv_worst = 0
         # True while a long prompt's chunked prefill is still running —
         # the slot holds its pages but must not join decode batches.
         self.prefilling = False
@@ -127,12 +142,17 @@ class _Slot:
 class _InFlight:
     """One dispatched-but-unprocessed decode block."""
 
-    __slots__ = ("block", "metas", "K", "releases")
+    __slots__ = ("block", "metas", "K", "releases", "spec_worst")
 
-    def __init__(self, block, metas, K):
-        self.block = block  # device [B, K+1]
-        self.metas = metas  # [(slot_idx, slot, first_col)]
+    def __init__(self, block, metas, K, spec_worst: int = 0):
+        # Plain blocks: device [B, K+1]. Speculative blocks: a
+        # (targets [B, K, r], counts [B, K]) tuple.
+        self.block = block
+        self.metas = metas  # [(slot_idx, slot, first_col | base_len)]
         self.K = K
+        # >0 marks a speculative block: worst-case tokens per slot
+        # (K * (k+1)); landing refunds the unaccepted remainder.
+        self.spec_worst = spec_worst
         self.releases: List = []  # SequencePages freed once this block lands
 
 
@@ -325,10 +345,26 @@ class LLMEngine:
         # Device-resident current token per slot (decode blocks chain
         # through it; the host only reads tokens one block behind).
         self._last_tokens = jnp.zeros((self.ecfg.max_batch_size,), jnp.int32)
+        # Speculative decoding state (speculative_k > 0): a device
+        # token-history buffer feeds the n-gram drafter, and lengths
+        # become DEVICE-authoritative (the host cannot know acceptance
+        # before a block lands, so host page bookkeeping tracks upper
+        # bounds and reconciles at landing).
+        self._spec_k = max(0, self.ecfg.speculative_k)
+        if self._spec_k:
+            self._history = jnp.zeros(
+                (self.ecfg.max_batch_size, self.ecfg.max_seq_len), jnp.int32)
+            self._dev_lengths = jnp.ones(
+                (self.ecfg.max_batch_size,), jnp.int32)
         if self._replicated is not None:
             self._rng = jax.device_put(self._rng, self._replicated)
             self._last_tokens = jax.device_put(self._last_tokens,
                                                self._replicated)
+            if self._spec_k:
+                self._history = jax.device_put(self._history,
+                                               self._replicated)
+                self._dev_lengths = jax.device_put(self._dev_lengths,
+                                                   self._replicated)
         self._inflight: deque = deque()
         # Prefill-sampled first tokens en route to the host via
         # copy_to_host_async: [(device_toks, [(slot_idx, slot), ...])].
@@ -434,7 +470,38 @@ class LLMEngine:
                         key, self.use_pallas, sampling_flags=flags,
                         mesh=self.mesh)
         B = self.ecfg.max_batch_size
+        if self._spec_k:
+            # Spec engines dispatch ONLY verify blocks; warm those
+            # (per outer-steps bucket) instead of the plain K variants.
+            for steps in ks:
+                (_, _, self._last_tokens, self._dev_lengths,
+                 self._history, self.pool) = engine_model.decode_spec_multi_step(
+                    self.params, self.cfg, self.pool, self._history,
+                    self._last_tokens, self._dev_lengths,
+                    self._put(np.zeros((B, self.max_pages), np.int32)),
+                    self._put(np.zeros((B,), bool)),
+                    n_steps=steps, k=self._spec_k,
+                    use_pallas=self.use_pallas, mesh=self.mesh)
+            # Admission history-write variants: every (group-size,
+            # bucket) shape _prefill_group can produce, plus the
+            # full-width chunked-prefill row — cold scatter compiles on
+            # the scheduler thread would stall live streams.
+            widths = list(buckets or self.buckets)
+            if long_prompts:
+                widths.append(self.ecfg.max_seq_len)
+            for bucket in widths:
+                for n in ([1] if bucket == self.ecfg.max_seq_len
+                          else group_sizes):
+                    self._history, self._dev_lengths = \
+                        engine_model.set_history_rows(
+                            self._history, self._dev_lengths,
+                            self._put(np.full((n,), B, np.int32)),
+                            self._put(np.zeros((n, bucket), np.int32)),
+                            self._put(np.ones((n,), np.int32)),
+                            self._put(np.zeros((n,), np.int32)))
         for k in ks:
+            if self._spec_k:
+                break
             for flags in flag_sets:
                 _, self._last_tokens, self.pool =                     engine_model.decode_multi_step(
                         self.params, self.cfg, self.pool,
@@ -503,6 +570,13 @@ class LLMEngine:
     # -- public API --------------------------------------------------------
 
     def submit(self, req: GenRequest) -> GenRequest:
+        if self._spec_k and req.temperature > 0.0:
+            raise ValueError(
+                "this engine runs greedy self-speculative decoding "
+                "(engine.speculative_k > 0), which verifies drafts "
+                "against argmax targets; sampled requests need a "
+                "non-speculative engine (set temperature=0 or "
+                "speculative_k=0)")
         # Prompts beyond the largest bucket go through CHUNKED prefill
         # (bucket-size pieces into a contiguous scratch cache, then one
         # scatter into the page pool), so the real ceiling is the page
@@ -629,7 +703,7 @@ class LLMEngine:
                 continue
             box: Dict[str, Any] = {}
             try:
-                box["host"] = np.asarray(blk)
+                box["host"] = _to_host(blk)
             except Exception as e:  # surfaced on the scheduler thread
                 box["err"] = e
             self._fetch_box = box
@@ -642,7 +716,7 @@ class LLMEngine:
         readback) and emits first tokens whose async copies landed —
         the two latency paths that used to wait out the fetch."""
         if self._reader is None or not self._reader.is_alive():
-            return np.asarray(fl.block)  # tests may drive _loop inline
+            return _to_host(fl.block)  # tests may drive _loop inline
         t0 = time.perf_counter() if self._debug_timing else 0.0
         self._fetch_done.clear()
         self._fetch_req.put(fl.block)
@@ -656,7 +730,7 @@ class LLMEngine:
                 except queue.Empty:
                     if self._fetch_done.wait(timeout=10):
                         break
-                return np.asarray(fl.block)
+                return _to_host(fl.block)
             self._emit_ready_first_tokens()
             # Mid-fetch admissions: only once the oldest arrival has
             # aged past a short debounce, so a burst batches into few
@@ -695,22 +769,8 @@ class LLMEngine:
                     continue
             except AttributeError:
                 pass  # non-jax array (tests): treat as ready
-            vals = np.asarray(toks).reshape(-1)
             self._pending_first.remove(item)
-            now = time.perf_counter()
-            for j, (slot_idx, slot) in enumerate(metas):
-                if self.slots[slot_idx] is not slot or slot.first_emitted:
-                    continue
-                slot.first_emitted = True
-                ttft_ms = (now - slot.req.submit_time) * 1e3
-                self.metrics.record_ttft(ttft_ms)
-                if slot.span is not None:
-                    slot.span.add_event("first_token",
-                                        {"ttft_ms": round(ttft_ms, 2)})
-                tok = int(vals[j])
-                slot.last_token = tok
-                self._emit(slot, tok, slot_idx=slot_idx)
-                self.metrics.record_tokens(1)
+            self._emit_first_values(np.asarray(toks).reshape(-1), metas)
 
     @property
     def _prefill_cap(self) -> int:
@@ -851,6 +911,10 @@ class LLMEngine:
         # out-of-bounds indices are dropped on device).
         self._last_tokens = engine_model.set_last_tokens(
             self._last_tokens, self._put(idxs), toks)
+        if self._spec_k:
+            self._history, self._dev_lengths = engine_model.set_history_rows(
+                self._history, self._dev_lengths, self._put(idxs),
+                self._put(tokens), self._put(lengths), toks)
         metas = []
         for req, slot_idx, seq, ids in entries:
             span = ManualSpan("engine.generate", context=req.trace_context,
@@ -967,6 +1031,14 @@ class LLMEngine:
         slot = _Slot(req, lp.seq, StreamDetokenizer(self.tokenizer),
                      span=span)
         self.slots[lp.slot_idx] = slot
+        if self._spec_k:
+            row = np.zeros((1, self.ecfg.max_seq_len), np.int32)
+            row[0, :len(lp.ids)] = lp.ids
+            self._history, self._dev_lengths = engine_model.set_history_rows(
+                self._history, self._dev_lengths,
+                self._put(np.asarray([lp.slot_idx], np.int32)),
+                self._put(row),
+                self._put(np.asarray([len(lp.ids)], np.int32)), tok0[None])
         # Same early first-token path as bucketed prefill.
         try:
             tok0.copy_to_host_async()
@@ -995,6 +1067,8 @@ class LLMEngine:
         Sampling happens on device and tokens chain device-side, so this
         returns without any host<->device sync; results are consumed
         later by _process_block."""
+        if self._spec_k:
+            return self._dispatch_decode_spec()
         B = len(self.slots)
         K = max(1, self.ecfg.decode_steps_per_dispatch)
         # (r3 had a K=1 "TTFT ramp" for slots awaiting their first
@@ -1134,6 +1208,109 @@ class LLMEngine:
         self._inflight.append(_InFlight(block, metas, K))
         return True
 
+    def _dispatch_decode_spec(self) -> bool:
+        """Speculative twin of _dispatch_decode: K outer VERIFY steps,
+        each committing 1..k+1 tokens. Lengths are device-authoritative
+        (acceptance is unknown until the block lands); the host ensures
+        pages for the worst case and reconciles at landing. Greedy-only
+        (enforced at submit)."""
+        B = len(self.slots)
+        r = self._spec_k + 1
+        steps = max(1, self.ecfg.decode_steps_per_dispatch)
+        tables = np.zeros((B, self.max_pages), np.int32)
+        active_mask = np.zeros((B,), bool)
+        live: List[int] = []
+        for i, s in enumerate(self.slots):
+            if s is None or s.prefilling:
+                continue
+            if s.req.cancelled:
+                self._finish(i, "cancelled")
+                continue
+            # A verify step writes k/v for up to r positions; a slot
+            # without r tokens of page capacity sits the block out (and
+            # is finished with "length" once its in-flight work drains).
+            cap = self.max_pages * self.pool.page_size \
+                - (s.kv_len + s.kv_worst)
+            if cap < r:
+                self._starve(i)
+                continue
+            if s.req.max_new_tokens - s.scheduled <= 0:
+                continue
+            live.append(i)
+        if not live:
+            return False
+        if len(live) * 4 <= B:
+            steps = min(steps, 2)  # same low-occupancy latency regime
+        cap_steps = min((self.max_pages * self.pool.page_size
+                         - (self.slots[i].kv_len + self.slots[i].kv_worst))
+                        // r for i in live)
+        max_rem = max(self.slots[i].req.max_new_tokens
+                      - self.slots[i].scheduled for i in live)
+        steps = self._pick_k(min(steps, max(1, cap_steps)))
+        if max_rem < steps:  # >=1 token commits per step
+            if self._warm_ks:
+                fits = sorted(k for k in self._warm_ks
+                              if max_rem <= k <= steps)
+                steps = fits[0] if fits else steps
+            else:
+                steps = self._pick_k(max(1, max_rem))
+        worst = steps * r
+        metas = []
+        active: List[int] = []
+        while True:
+            shrink_to = None
+            active = []
+            active_mask[:] = False
+            for i in live:
+                s = self.slots[i]
+                if s is None:
+                    continue
+                bound = s.kv_len + s.kv_worst
+                try:
+                    s.seq.ensure(bound + worst)
+                except MemoryError:
+                    in_page_cap = len(s.seq.pages) * self.pool.page_size \
+                        - bound
+                    if in_page_cap >= r and steps > 1:
+                        shrink_to = max(1, in_page_cap // r)
+                        break
+                    if in_page_cap < r:
+                        self._starve(i)
+                    continue
+                active.append(i)
+                active_mask[i] = True
+                tables[i] = s.seq.table_row()
+                metas.append((i, s, bound))
+            if shrink_to is None:
+                break
+            steps = self._pick_k(shrink_to)
+            worst = steps * r
+            metas = []
+        if not active:
+            return False
+        (targets, counts, self._last_tokens, self._dev_lengths,
+         self._history, self.pool) = engine_model.decode_spec_multi_step(
+            self.params, self.cfg, self.pool, self._history,
+            self._last_tokens, self._dev_lengths, self._put(tables),
+            self._put(active_mask), n_steps=steps, k=self._spec_k,
+            use_pallas=self.use_pallas, mesh=self.mesh)
+        for i in active:
+            s = self.slots[i]
+            s.awaiting_first = False
+            s.scheduled += worst
+            s.kv_worst += worst
+        self.metrics.decode_steps += steps
+        self.metrics.busy_slots_acc += len(active) * steps
+        if self._async_block_copy:
+            for b in (targets, counts):
+                try:
+                    b.copy_to_host_async()
+                except AttributeError:
+                    pass
+        self._inflight.append(_InFlight((targets, counts), metas, steps,
+                                        spec_worst=worst))
+        return True
+
     def _pick_k(self, bound: int) -> int:
         """Largest dispatchable K <= bound: power-of-two, and (when a
         warmup ran) restricted to the precompiled variants. K=1 always
@@ -1172,10 +1349,17 @@ class LLMEngine:
                        for _, s, _ in fl.metas):
                 self._finish(i, "length")
 
-    def _process_block_host(self, fl: _InFlight, block: np.ndarray) -> None:
+    def _process_block_host(self, fl: _InFlight, block) -> None:
         """Emit/finish slots from a block already fetched to the host
-        ([B, K+1]; scheduler thread)."""
+        ([B, K+1], or (targets, counts) for speculative blocks;
+        scheduler thread)."""
         now = time.perf_counter()
+        if fl.spec_worst:
+            # Records its own token count (the first-token flush inside
+            # it already self-records; a wrapper delta would double-
+            # count those).
+            self._process_spec_block(fl, block)
+            return
         tokens_before = self.metrics.tokens_out
         for i, slot, first_col in fl.metas:
             if self.slots[i] is not slot:
@@ -1201,6 +1385,70 @@ class LLMEngine:
                 if self.slots[i] is not slot:
                     break  # finished mid-block; rest is overshoot
         self.metrics.record_tokens(self.metrics.tokens_out - tokens_before)
+
+    def _process_spec_block(self, fl: _InFlight, block) -> None:
+        """Emit a landed speculative block: per slot and outer step,
+        the first counts[i, s] entries of targets[i, s] are committed
+        greedy tokens. Reconciles the host's worst-case page/budget
+        bookkeeping with the actual acceptance."""
+        targets, counts = block
+        block_emitted = 0
+        for i, slot, base_len in fl.metas:
+            if self.slots[i] is not slot:
+                continue  # retired while in flight
+            if not slot.first_emitted:
+                # The first token (async prefill copy) must hit the
+                # stream before any decode tokens; force it now.
+                self._flush_first_for(slot)
+            emitted = 0
+            for s_ in range(fl.K):
+                for j in range(int(counts[i, s_])):
+                    tok = int(targets[i, s_, j])
+                    slot.last_token = tok
+                    self._emit(slot, tok, slot_idx=i)
+                    emitted += 1
+                    if self.slots[i] is not slot:
+                        break
+                if self.slots[i] is not slot:
+                    break
+            if self.slots[i] is slot:
+                # Refund the unaccepted worst-case tokens so the budget
+                # cap doesn't strand the request; kv_len/kv_worst move
+                # the page bookkeeping to the actual acceptance while
+                # still covering any sibling block in flight.
+                slot.scheduled -= fl.spec_worst - emitted
+                slot.kv_len += emitted
+                slot.kv_worst -= fl.spec_worst
+            block_emitted += emitted
+        self.metrics.record_tokens(block_emitted)
+
+    def _flush_first_for(self, slot: "_Slot") -> None:
+        """Blocking emission of one slot's pending first token (its
+        transfer started at prefill dispatch, so this is near-free by
+        the time a decode block for the same slot has landed)."""
+        for item in list(self._pending_first):
+            toks, metas = item
+            if not any(s is slot for _, s in metas):
+                continue
+            self._pending_first.remove(item)
+            self._emit_first_values(np.asarray(toks).reshape(-1), metas)
+            return
+
+    def _emit_first_values(self, vals: np.ndarray, metas) -> None:
+        now = time.perf_counter()
+        for j, (slot_idx, slot) in enumerate(metas):
+            if self.slots[slot_idx] is not slot or slot.first_emitted:
+                continue
+            slot.first_emitted = True
+            ttft_ms = (now - slot.req.submit_time) * 1e3
+            self.metrics.record_ttft(ttft_ms)
+            if slot.span is not None:
+                slot.span.add_event("first_token",
+                                    {"ttft_ms": round(ttft_ms, 2)})
+            tok = int(vals[j])
+            slot.last_token = tok
+            self._emit(slot, tok, slot_idx=slot_idx)
+            self.metrics.record_tokens(1)
 
     def _emit(self, slot: _Slot, tok: int, slot_idx: int) -> None:
         self.metrics.tokens_out += 1

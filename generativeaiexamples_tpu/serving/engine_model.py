@@ -32,36 +32,53 @@ from generativeaiexamples_tpu.serving.paged_attention import (
     paged_attention_dispatch)
 
 
-def _write_prefill_pages(pool, kw, vw, li, table_idx):
-    """Scatter page-shaped prefill k/v (value layout [..., KH, ps, Hd],
-    matching the advanced-index pattern `pool.k.at[li, :, table_idx]`)
-    into the pool; int8 pools quantize per (kv-head, token) row with
-    narrow scales and write k/v fused side by side — ONE scatter for
-    both (serving/paged_attention_int8.py, kv_cache.QuantPagePool)."""
+def _page_axes(L, KH, table_flat):
+    li = jnp.arange(L)[:, None, None]
+    kh = jnp.arange(KH)[None, :, None]
+    return li, kh, table_flat[None, None, :]
+
+
+def _write_prefill_pages(pool, kw, vw, table_flat):
+    """Scatter page-shaped prefill k/v (canonical layout
+    [L, KH, M, ps, Hd], pages flattened across the group) into the
+    pool; int8 pools quantize per (kv-head, token) row with narrow
+    scales and write into the fused pool
+    (serving/paged_attention_int8.py, kv_cache.QuantPagePool).
+
+    ALL advanced indices are contiguous from axis 0 ([li, kh, pages] /
+    [0, li, kh, pages]) — the old bracketed form `at[li, :, pages]`
+    made XLA materialize a full copy of the donated pool once the
+    group had >1 row, which is +3.3 GB HBM at the B=128 deployment
+    shape and an OOM at long-context pool sizes."""
+    L, KH = kw.shape[:2]
+    li, kh, tb = _page_axes(L, KH, table_flat)
     if pool.quantized:
         from generativeaiexamples_tpu.serving.paged_attention_int8 import (
             quantize_kv)
 
         kq, ks = quantize_kv(kw, scale_dtype=pool.s.dtype)
         vq, vs = quantize_kv(vw, scale_dtype=pool.s.dtype)
-        return _write_quant_pages(pool, kq, ks, vq, vs, li, table_idx)
-    return PagePool(pool.k.at[li, :, table_idx].set(kw.astype(pool.k.dtype)),
-                    pool.v.at[li, :, table_idx].set(vw.astype(pool.v.dtype)),
+        return _write_quant_pages(pool, kq, ks, vq, vs, table_flat)
+    return PagePool(pool.k.at[li, kh, tb].set(kw.astype(pool.k.dtype)),
+                    pool.v.at[li, kh, tb].set(vw.astype(pool.v.dtype)),
                     pool.page_size)
 
 
-def _write_quant_pages(pool, kq, ks, vq, vs, li, table_idx):
-    """Scatter pre-quantized page-shaped k/v codes + narrow scales into
-    the fused pool. TWO scatters (k then v) with a scalar leading
-    index: a single stacked [2, ...] update drives XLA to a transposed
-    pool layout whose conversion copies the whole 3 GB pool (OOM);
-    separate scatters keep the natural layout and alias in place."""
+def _write_quant_pages(pool, kq, ks, vq, vs, table_flat):
+    """Scatter pre-quantized page-shaped k/v codes ([L, KH, M, ps, Hd])
+    + narrow scales ([L, KH, M, ps]) into the fused pool. TWO scatters
+    (k then v) with a scalar leading index: a single stacked [2, ...]
+    update drives XLA to a transposed pool layout whose conversion
+    copies the whole 3 GB pool (OOM); separate scatters with contiguous
+    advanced indices keep the natural layout and alias in place."""
     from generativeaiexamples_tpu.serving.kv_cache import QuantPagePool
 
-    kv = pool.kv.at[0, li, :, table_idx].set(kq)
-    kv = kv.at[1, li, :, table_idx].set(vq)
-    s = pool.s.at[0, li, :, table_idx].set(ks)
-    s = s.at[1, li, :, table_idx].set(vs)
+    L, KH = kq.shape[:2]
+    li, kh, tb = _page_axes(L, KH, table_flat)
+    kv = pool.kv.at[0, li, kh, tb].set(kq)
+    kv = kv.at[1, li, kh, tb].set(vq)
+    s = pool.s.at[0, li, kh, tb].set(ks)
+    s = s.at[1, li, kh, tb].set(vs)
     return QuantPagePool(kv, s, pool.page_size)
 
 
@@ -123,14 +140,13 @@ def prefill_step(
         return x, (k[0].transpose(1, 0, 2), v[0].transpose(1, 0, 2))  # [S,KH,Hd]
 
     x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
-    # [L, S, KH, Hd] -> pages [L, npages, KH, ps, Hd]; scatter once into
-    # the [L, KH, P, ps, Hd] pool (advanced indices bracket the KH slice,
-    # so the value keeps the [L, npages, KH, ps, Hd] block layout).
+    # [L, S, KH, Hd] -> canonical pages [L, KH, npages, ps, Hd]; scatter
+    # once into the [L, KH, P, ps, Hd] pool with contiguous advanced
+    # indices (see _write_prefill_pages).
     L = k_stack.shape[0]
-    kw = k_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
-    vw = v_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 1, 3, 2, 4)
-    li = jnp.arange(L)[:, None]
-    pool = _write_prefill_pages(pool, kw, vw, li, table_row[None, :])
+    kw = k_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 3, 1, 2, 4)
+    vw = v_stack.reshape(L, npages, ps, KH, Hd).transpose(0, 3, 1, 2, 4)
+    pool = _write_prefill_pages(pool, kw, vw, table_row)
     last = jnp.take_along_axis(
         x, (length - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)  # [1,1,D]
     logits = _logits(cfg, params, last)[0, 0]
@@ -194,21 +210,20 @@ def prefill_batch_step(
 
     x, kv_out = jax.lax.scan(body, x, params["layers"])
     L = cfg.n_layers
-    li = jnp.arange(L)[:, None, None]
 
-    def paged(t):  # [L, N, S, KH, ...] -> [L, N, npages, KH, ps, ...]
+    def paged(t):  # [L, N, S, KH, ...] -> [L, KH, N*npages, ps, ...]
         rest = t.shape[4:]
         t = t.reshape(L, N, npages, ps, KH, *rest)
-        order = (0, 1, 2, 4, 3) + tuple(5 + i for i in range(len(rest)))
-        return t.transpose(*order)
+        order = (0, 4, 1, 2, 3) + tuple(5 + i for i in range(len(rest)))
+        return t.transpose(*order).reshape(L, KH, N * npages, ps, *rest)
 
+    flat_rows = table_rows.reshape(-1)
     if quantized:
         kq, ks, vq, vs = (paged(t) for t in kv_out)
-        pool = _write_quant_pages(pool, kq, ks, vq, vs, li,
-                                  table_rows[None, :, :])
+        pool = _write_quant_pages(pool, kq, ks, vq, vs, flat_rows)
     else:
         kw, vw = (paged(t) for t in kv_out)
-        pool = _write_prefill_pages(pool, kw, vw, li, table_rows[None, :, :])
+        pool = _write_prefill_pages(pool, kw, vw, flat_rows)
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
     logits = _logits(cfg, params, last)[:, 0]  # [N, V]
@@ -389,6 +404,218 @@ def decode_multi_step(
     return jnp.stack(out_tokens, axis=1), tokens, pool
 
 
+# -- speculative decode (greedy self-speculation) ------------------------
+#
+# The NIM/TensorRT-LLM engines ship draft-based speculative decoding;
+# this is the TPU-native equivalent, designed around the platform's
+# actual bottleneck (HBM bandwidth: ~8 GB of int8 weights per decode
+# step). One VERIFY step runs k draft tokens + the current token
+# through a single forward — one weight read for up to k+1 committed
+# tokens. Drafting is ON DEVICE (n-gram lookup over a device-resident
+# token-history buffer), so the fused multi-step block still needs no
+# host sync and the scheduler's pipelining is unchanged.
+#
+# Greedy-only by construction: verification compares drafts against
+# argmax targets, so emitted tokens are ALWAYS exactly the sequential
+# greedy continuation — acceptance only changes speed, never content
+# (tests pin stream equality against the non-speculative engine).
+
+
+def ngram_draft(history: jax.Array, lengths: jax.Array, t0: jax.Array,
+                k: int) -> jax.Array:
+    """Propose k draft tokens per row: the tokens FOLLOWING the most
+    recent previous occurrence of the current token t0 in that row's
+    history (prompt + generated so far). Rows without a previous
+    occurrence fall back to repeating t0 (harmless: rejection costs
+    nothing beyond the verify positions already paid for).
+
+    history [B, Hcap] int32, lengths [B] (tokens incl. current; t0
+    lives at history[b, lengths[b]-1]), t0 [B] -> [B, k]."""
+    _, Hcap = history.shape
+    pos = jnp.arange(Hcap)[None, :]
+    cur = (lengths - 1)[:, None]
+    m = (history == t0[:, None]) & (pos < cur)
+    has = m.any(axis=1)
+    last = jnp.argmax(jnp.where(m, pos, -1), axis=1)
+    gidx = jnp.clip(last[:, None] + jnp.arange(1, k + 1)[None, :],
+                    0, Hcap - 1)
+    d = jnp.take_along_axis(history, gidx, axis=1)
+    return jnp.where(has[:, None], d, t0[:, None])
+
+
+def _decode_verify_once(params, cfg: LlamaConfig, pool: PagePool,
+                        tokens: jax.Array,       # [B, r] t0 + drafts
+                        page_tables: jax.Array,  # [B, maxp]
+                        lengths: jax.Array,      # [B] incl. t0
+                        use_pallas, mesh=None):
+    """One verify forward over r=k+1 positions per sequence: projects
+    q/k/v for all r positions in ONE weight read, writes their k/v into
+    the pool pages (write-then-attend, same as _decode_once), and runs
+    paged attention with the r positions FOLDED INTO THE KERNEL BATCH
+    (row (b, i) attends prefix lengths[b]+i). Returns
+    (logits [B, r, V], pool). Rejected positions need no cleanup: the
+    sequence length never advances past the accepted prefix, so stale
+    pool entries are masked now and overwritten later."""
+    B, r = tokens.shape
+    ps = pool.page_size
+    maxp = page_tables.shape[1]
+    KH = cfg.n_kv_heads
+    offs = jnp.arange(r)[None, :]
+    positions = (lengths - 1)[:, None] + offs          # [B, r]
+    page_idx = jnp.take_along_axis(
+        page_tables, jnp.clip(positions // ps, 0, maxp - 1), axis=1)  # [B,r]
+    offset = positions % ps                            # [B, r]
+    kh_idx = jnp.arange(KH)[:, None, None]             # [KH,1,1]
+    flat_tables = jnp.repeat(page_tables, r, axis=0)   # [B*r, maxp]
+    flat_lengths = (lengths[:, None] + offs).reshape(-1)  # [B*r]
+
+    x = params["tok_emb"][tokens].astype(cfg.dtype)    # [B, r, D]
+    quantized = pool.quantized
+    if quantized:
+        from generativeaiexamples_tpu.serving.kv_cache import QuantPagePool
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            quantize_kv)
+
+    def body(x, pools, w, l):
+        h = rms_norm(x, w["ln1"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, h, w, positions)   # [B, *, r, Hd]
+        k_new = k.transpose(1, 0, 2, 3)                # [KH, B, r, Hd]
+        v_new = v.transpose(1, 0, 2, 3)
+        qf = q.transpose(0, 2, 1, 3).reshape(B * r, cfg.n_heads,
+                                             cfg.head_dim)
+        if quantized:
+            kv_pool, s_pool = pools
+            kq, ksc = quantize_kv(k_new, scale_dtype=s_pool.dtype)
+            vq, vsc = quantize_kv(v_new, scale_dtype=s_pool.dtype)
+            kv_pool = kv_pool.at[
+                0, l, kh_idx, page_idx[None], offset[None], :].set(kq)
+            kv_pool = kv_pool.at[
+                1, l, kh_idx, page_idx[None], offset[None], :].set(vq)
+            s_pool = s_pool.at[
+                0, l, kh_idx, page_idx[None], offset[None]].set(ksc)
+            s_pool = s_pool.at[
+                1, l, kh_idx, page_idx[None], offset[None]].set(vsc)
+            out = paged_attention_dispatch(
+                qf, kv_pool, None, flat_tables, flat_lengths,
+                k_scales=s_pool, layer=l, use_pallas=use_pallas, mesh=mesh)
+            new_pools = (kv_pool, s_pool)
+        else:
+            k_pool, v_pool = pools
+            k_pool = k_pool.at[
+                l, kh_idx, page_idx[None], offset[None], :].set(
+                k_new.astype(k_pool.dtype))
+            v_pool = v_pool.at[
+                l, kh_idx, page_idx[None], offset[None], :].set(
+                v_new.astype(v_pool.dtype))
+            out = paged_attention_dispatch(
+                qf, k_pool[l], v_pool[l], flat_tables, flat_lengths,
+                use_pallas=use_pallas, mesh=mesh)
+            new_pools = (k_pool, v_pool)
+        out = out.reshape(B, r, cfg.n_heads, cfg.head_dim)
+        out = out.transpose(0, 2, 1, 3)                # [B, H, r, Hd]
+        x = _finish_block(cfg, x, out, w)
+        return x, new_pools
+
+    pools = (pool.kv, pool.s) if quantized else (pool.k, pool.v)
+    if _UNROLL_DECODE:
+        from generativeaiexamples_tpu.ops.quant import QuantizedTensor
+
+        def take(t, l):
+            if isinstance(t, QuantizedTensor):
+                return QuantizedTensor(t.q[l], t.s[l])
+            return t[l]
+
+        for l in range(cfg.n_layers):
+            w = {k2: take(v2, l) for k2, v2 in params["layers"].items()}
+            x, pools = body(x, pools, w, l)
+    else:
+        def scan_body(carry, wl):
+            x, pools = carry
+            w, l = wl
+            return body(x, pools, w, l), None
+
+        (x, pools), _ = jax.lax.scan(
+            scan_body, (x, pools),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    logits = _logits(cfg, params, x)                   # [B, r, V]
+    if quantized:
+        return logits, QuantPagePool(pools[0], pools[1], ps)
+    return logits, PagePool(pools[0], pools[1], ps)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "k",
+                                             "use_pallas", "mesh"),
+                   donate_argnames=("pool", "history", "dev_lengths",
+                                    "last_tokens"))
+def decode_spec_multi_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    history: jax.Array,       # [B, Hcap] device token history
+    last_tokens: jax.Array,   # [B] device-resident current token
+    dev_lengths: jax.Array,   # [B] device-resident lengths incl. current
+    page_tables: jax.Array,   # [B, maxp]
+    active: jax.Array,        # [B] bool
+    n_steps: int, k: int,
+    use_pallas: Optional[bool] = None,
+    mesh=None,
+):
+    """n_steps fused VERIFY steps. Each step drafts k tokens from the
+    history buffer, verifies them in one forward, commits the accepted
+    prefix + one bonus token (>=1 token per step, exactly the greedy
+    continuation), and chains tokens/lengths/history on device.
+
+    Returns (targets [B, n_steps, k+1], counts [B, n_steps],
+    last_tokens, dev_lengths, history, pool). The host emits
+    targets[b, s, :counts[b, s]] per landed block; lengths are device-
+    authoritative because the host cannot know acceptance in advance."""
+    B = last_tokens.shape[0]
+    Hcap = history.shape[1]
+    bi = jnp.arange(B)[:, None]
+    out_t, out_c = [], []
+    for _ in range(n_steps):
+        draft = ngram_draft(history, dev_lengths, last_tokens, k)
+        tokens_in = jnp.concatenate([last_tokens[:, None], draft], axis=1)
+        logits, pool = _decode_verify_once(
+            params, cfg, pool, tokens_in, page_tables, dev_lengths,
+            use_pallas, mesh)
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, r]
+        ok = (draft == targets[:, :-1])
+        acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+        counts = jnp.where(active, acc + 1, 0)
+        bonus = jnp.take_along_axis(targets, acc[:, None], axis=1)[:, 0]
+        # History gains the committed continuation at positions
+        # len..len+k; entries past the accepted prefix are provisional
+        # garbage that the length mask hides until overwritten.
+        hpos = jnp.clip(dev_lengths[:, None] + jnp.arange(k + 1)[None, :],
+                        0, Hcap - 1)
+        old = jnp.take_along_axis(history, hpos, axis=1)
+        history = history.at[bi, hpos].set(
+            jnp.where(active[:, None], targets, old))
+        dev_lengths = jnp.where(active, dev_lengths + counts, dev_lengths)
+        last_tokens = jnp.where(active, bonus, last_tokens)
+        out_t.append(targets)
+        out_c.append(counts)
+    return (jnp.stack(out_t, axis=1), jnp.stack(out_c, axis=1),
+            last_tokens, dev_lengths, history, pool)
+
+
+@functools.partial(jax.jit, donate_argnames=("history", "dev_lengths"))
+def set_history_rows(history: jax.Array, dev_lengths: jax.Array,
+                     idxs: jax.Array, tokens: jax.Array,
+                     lengths: jax.Array, first_toks: jax.Array):
+    """Write admitted prompts + the prefill-sampled first token into
+    the history buffer, and set the device length vector to
+    prompt_len + 1 (token at lengths-1 is the current one). Batched
+    admission twin of set_last_tokens; padding rows carry an
+    out-of-bounds index and are dropped."""
+    N, S = tokens.shape
+    history = history.at[idxs[:, None],
+                         jnp.arange(S)[None, :]].set(tokens, mode="drop")
+    history = history.at[idxs, lengths].set(
+        first_toks.astype(history.dtype), mode="drop")
+    dev_lengths = dev_lengths.at[idxs].set(lengths + 1, mode="drop")
+    return history, dev_lengths
+
+
 @functools.partial(jax.jit, static_argnames=("all_greedy", "any_top_k",
                                              "any_top_p"))
 def sample_token(logits: jax.Array, temperature, top_p, top_k, key,
@@ -453,7 +680,7 @@ def cache_to_pool(
     ps = pool.page_size
     L, _, KH, S, Hd = cache.k.shape
     npages = S // ps
-    kw = cache.k[:, 0].reshape(L, KH, npages, ps, Hd).transpose(0, 2, 1, 3, 4)
-    vw = cache.v[:, 0].reshape(L, KH, npages, ps, Hd).transpose(0, 2, 1, 3, 4)
-    li = jnp.arange(L)[:, None]
-    return _write_prefill_pages(pool, kw, vw, li, table_row[None, :])
+    # Already in the canonical [L, KH, npages, ps, Hd] order.
+    kw = cache.k[:, 0].reshape(L, KH, npages, ps, Hd)
+    vw = cache.v[:, 0].reshape(L, KH, npages, ps, Hd)
+    return _write_prefill_pages(pool, kw, vw, table_row)

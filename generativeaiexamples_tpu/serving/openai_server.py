@@ -200,6 +200,14 @@ class OpenAIServer:
                            "type": "invalid_request_error",
                            "code": "context_length_exceeded"}},
                 status=422)
+        except ValueError as e:
+            # e.g. a sampled request against a greedy-only speculative
+            # engine — bad client input, not a server fault.
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error",
+                           "code": "unsupported_parameter"}},
+                status=422)
         created = int(time.time())
         obj = "chat.completion.chunk" if chat else "text_completion"
 

@@ -124,7 +124,31 @@ class StreamDetokenizer:
 
 
 def load_tokenizer(name_or_path: str):
-    """"byte" -> hermetic ByteTokenizer; else HF tokenizer dir/file."""
+    """"byte" -> hermetic ByteTokenizer; else HF tokenizer dir/file.
+    A checkpoint directory WITHOUT a tokenizer.json (e.g. a seeded
+    weights-only snapshot) falls back to the byte tokenizer with a
+    warning instead of failing the whole server boot."""
     if name_or_path in ("", "byte", "test"):
+        return ByteTokenizer()
+    f = (name_or_path if name_or_path.endswith(".json")
+         else os.path.join(name_or_path, "tokenizer.json"))
+    if not os.path.isfile(f):
+        import glob
+        import logging
+
+        # Fall back ONLY for a weights-only checkpoint directory (e.g.
+        # a seeded snapshot): real weights are present but no tokenizer
+        # was saved. A typo'd or empty path still fails loudly.
+        has_weights = os.path.isdir(name_or_path) and (
+            glob.glob(os.path.join(name_or_path, "*.safetensors"))
+            or glob.glob(os.path.join(name_or_path, "*.bin")))
+        if not has_weights:
+            raise FileNotFoundError(
+                f"no tokenizer.json under {name_or_path!r} (and no model "
+                f"weights found there to justify a byte-tokenizer "
+                f"fallback)")
+        logging.getLogger(__name__).warning(
+            "weights-only checkpoint %s has no tokenizer.json; using the "
+            "byte tokenizer", name_or_path)
         return ByteTokenizer()
     return HFTokenizer(name_or_path)

@@ -43,6 +43,13 @@ DOCS = ["docs/architecture.md", "docs/deployment.md",
         "docs/observability.md", "docs/support-matrix.md"]
 SERVE_PORT, CHAIN_PORT = 8199, 8198
 
+# The axon TPU plugin lives on the image's default PYTHONPATH
+# (/root/.axon_site) — child processes must keep it or they lose the
+# chip (ENGINEERING_NOTES platform facts: append, never replace).
+_CHILD_PYTHONPATH = os.pathsep.join(
+    p for p in [ROOT, os.environ.get("PYTHONPATH", ""),
+                "/root/.axon_site"] if p)
+
 
 def wait_http(url: str, timeout_s: float) -> None:
     t0 = time.time()
@@ -68,12 +75,15 @@ def main() -> int:
         env_a = dict(os.environ,
                      APP_ENGINE_WEIGHTSPATH=ckpt,
                      APP_LLM_MODELNAME="tiny-llama-seeded",
+                     # Byte tokenizer: ~1 token per character, so RAG
+                     # and judge prompts (context + answer + template)
+                     # run 3-5k tokens — 8k context with a 4k direct-
+                     # prefill bucket keeps them off the chunked path.
                      APP_ENGINE_MAXBATCHSIZE="4",
-                     APP_ENGINE_MAXSEQLEN="2048",
+                     APP_ENGINE_MAXSEQLEN="16384",
                      APP_ENGINE_PAGESIZE="128",
-                     APP_ENGINE_PREFILLBUCKETS="1024",
-                     PYTHONPATH=ROOT + os.pathsep
-                     + os.environ.get("PYTHONPATH", ""))
+                     APP_ENGINE_PREFILLBUCKETS="[512, 4096]",
+                     PYTHONPATH=_CHILD_PYTHONPATH)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "generativeaiexamples_tpu.serving",
              "--port", str(SERVE_PORT)],
@@ -87,8 +97,7 @@ def main() -> int:
                      APP_LLM_SERVERURL=f"http://127.0.0.1:{SERVE_PORT}/v1",
                      APP_LLM_MODELNAME="tiny-llama-seeded",
                      APP_EMBEDDINGS_MODELENGINE="hash",
-                     PYTHONPATH=ROOT + os.pathsep
-                     + os.environ.get("PYTHONPATH", ""))
+                     PYTHONPATH=_CHILD_PYTHONPATH)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "generativeaiexamples_tpu.api.server",
              "--port", str(CHAIN_PORT)],
