@@ -476,6 +476,20 @@ def _decode_verify_once(params, cfg: LlamaConfig, pool: PagePool,
         from generativeaiexamples_tpu.serving.paged_attention_int8 import (
             quantize_kv)
 
+    # The fused multi-query kernel streams each sequence's KV pages
+    # ONCE for all r positions (folding positions into the batch costs
+    # r x the KV traffic and r x the kernel's DMA issues). Single-device
+    # TPU with the Pallas-eligible head_dim only; everything else takes
+    # the flat-batch path through the normal dispatch.
+    from generativeaiexamples_tpu.serving import paged_attention as _pa
+
+    fused_multi = (quantized and mesh is None and _pa.pltpu is not None
+                   and (use_pallas if use_pallas is not None
+                        else jax.default_backend() == "tpu")
+                   and cfg.head_dim % 128 == 0
+                   and pool.page_size % 128 == 0  # Mosaic lane alignment
+                   and os.environ.get("ENGINE_FUSED_VERIFY", "1") != "0")
+
     def body(x, pools, w, l):
         h = rms_norm(x, w["ln1"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, h, w, positions)   # [B, *, r, Hd]
@@ -495,9 +509,20 @@ def _decode_verify_once(params, cfg: LlamaConfig, pool: PagePool,
                 0, l, kh_idx, page_idx[None], offset[None]].set(ksc)
             s_pool = s_pool.at[
                 1, l, kh_idx, page_idx[None], offset[None]].set(vsc)
-            out = paged_attention_dispatch(
-                qf, kv_pool, None, flat_tables, flat_lengths,
-                k_scales=s_pool, layer=l, use_pallas=use_pallas, mesh=mesh)
+            if fused_multi:
+                from generativeaiexamples_tpu.serving.paged_attention_int8 \
+                    import paged_attention_int8
+
+                qm = q.transpose(0, 2, 1, 3)  # [B, r, H, Hd]
+                out = paged_attention_int8(
+                    qm, kv_pool, s_pool, page_tables, lengths, l,
+                    q_rep=r)
+                out = out.reshape(B * r, cfg.n_heads, cfg.head_dim)
+            else:
+                out = paged_attention_dispatch(
+                    qf, kv_pool, None, flat_tables, flat_lengths,
+                    k_scales=s_pool, layer=l, use_pallas=use_pallas,
+                    mesh=mesh)
             new_pools = (kv_pool, s_pool)
         else:
             k_pool, v_pool = pools

@@ -137,8 +137,15 @@ def _int8_kernel(
     maxp: int,
     page_size: int,
     batch_size: int,
+    q_rep: int = 1,
 ):
     """One grid step per BATCH ROW, all kv heads + k and v together.
+
+    q_rep > 1 (speculative verify): the G axis carries q_rep query
+    positions per head group, j-major (row = j * G_base + g); query
+    sub-row j sits at sequence position length-1+j and masks
+    pos < length + j. The KV stream is read ONCE for all positions —
+    the whole point vs folding positions into the batch.
 
     Design rules, measured on a v5e through the real decode path
     (scripts/decompose_decode.py):
@@ -152,8 +159,10 @@ def _int8_kernel(
     ps = page_size
     bk = ppcb * ps
     length = lengths_ref[b]
-    nblk = lax.div(length + bk - 1, bk)
+    span = length + (q_rep - 1)  # kv entries the LAST query row sees
+    nblk = lax.div(span + bk - 1, bk)
     KH, G, Hd = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    g_base = G // q_rep
 
     layer = layer_ref[0]
 
@@ -167,7 +176,7 @@ def _int8_kernel(
         """Block after (b, i-1): block i of this row if still inside
         the sequence, else the next row's first block (lengths >= 1, so
         every row has at least one block)."""
-        return lax.cond(i * bk < length,
+        return lax.cond(i * bk < span,
                         lambda: (b, i),
                         lambda: (b + 1, jnp.int32(0)))
 
@@ -206,7 +215,11 @@ def _int8_kernel(
                 q, kq, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * ks  # [KH, G, ps]
             pos = i * bk + j * ps + lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            s = jnp.where(pos < length, s, NEG_INF)
+            limit = length
+            if q_rep > 1:
+                limit = length + lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1) // g_base
+            s = jnp.where(pos < limit, s, NEG_INF)
 
             m_curr = jnp.max(s, axis=2, keepdims=True)  # [KH, G, 1]
             m_new = jnp.maximum(m_prev, m_curr)
@@ -235,36 +248,55 @@ def _pages_per_block(maxp: int, want: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("scale",
-                                             "pages_per_compute_block"))
+                                             "pages_per_compute_block",
+                                             "q_rep"))
 def paged_attention_int8(
-    q: jax.Array,          # [B, H, Hd]
+    q: jax.Array,          # [B, H, Hd], or [B, R, H, Hd] when q_rep=R>1
     kv_pages: jax.Array,   # FULL pool [2, L, KH, P, ps, Hd] int8
     kv_scales: jax.Array,  # FULL scales [2, L, KH, P, ps] f32
     page_table: jax.Array,  # [B, maxp] int32
-    lengths: jax.Array,     # [B] int32, incl. current token
+    lengths: jax.Array,     # [B] int32, incl. current token (R>1: the
+                            # FIRST query's; query j attends lengths+j)
     layer,                  # int32 scalar: which layer to attend over
     *,
     scale: float | None = None,
     pages_per_compute_block: int | None = None,
+    q_rep: int = 1,
 ) -> jax.Array:
+    """q_rep > 1 is the speculative-verify form: R consecutive query
+    positions per sequence ride the kernel's G axis, so the KV pages
+    stream from HBM ONCE per sequence instead of once per position
+    (folding positions into the batch costs R x the KV traffic AND
+    R x the DMA issues — the measured kernel floor)."""
     if pltpu is None:
         raise RuntimeError("Pallas TPU unavailable; use the reference path")
-    B, H, Hd = q.shape
+    if q_rep > 1:
+        B, R, H, Hd = q.shape
+        assert R == q_rep, (q.shape, q_rep)
+    else:
+        B, H, Hd = q.shape
     two, L, KH, P, ps, _ = kv_pages.shape
     assert two == 2, kv_pages.shape
     maxp = page_table.shape[1]
-    G = H // KH
+    G = (H // KH) * q_rep
     s = scale if scale is not None else Hd ** -0.5
-    ppcb = _pages_per_block(maxp, pages_per_compute_block or 8)
 
-    qk = (q.astype(jnp.float32) * s).reshape(B, KH, G, Hd)
+    if q_rep > 1:
+        # j-major rows: row = j * (H//KH) + g, matching the kernel's
+        # qoff = row // g_base masking.
+        qk = (q.astype(jnp.float32) * s).reshape(
+            B, q_rep, KH, H // KH, Hd).transpose(0, 2, 1, 3, 4).reshape(
+            B, KH, G, Hd)
+    else:
+        qk = (q.astype(jnp.float32) * s).reshape(B, KH, G, Hd)
+    ppcb = _pages_per_block(maxp, pages_per_compute_block or 8)
     # Scale pages as 2-D [1, ps] tiles (metadata-only reshape of the
     # CONTIGUOUS full array): the kernel DMAs and consumes them without
     # any vector relayout.
     s2 = kv_scales.reshape(2, L, KH, P, 1, ps)
 
     kernel = functools.partial(_int8_kernel, ppcb=ppcb, maxp=maxp,
-                               page_size=ps, batch_size=B)
+                               page_size=ps, batch_size=B, q_rep=q_rep)
     qmap = lambda b, Ln, T, LY, BI, IF: (b, 0, 0, 0)  # noqa: E731
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
@@ -299,4 +331,7 @@ def paged_attention_int8(
       jnp.asarray(layer, jnp.int32).reshape(1),
       jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
       qk, kv_pages, s2)
+    if q_rep > 1:
+        return out.reshape(B, KH, q_rep, H // KH, Hd).transpose(
+            0, 2, 1, 3, 4).reshape(B, q_rep, H, Hd).astype(q.dtype)
     return out.reshape(B, H, Hd).astype(q.dtype)

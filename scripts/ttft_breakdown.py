@@ -57,7 +57,7 @@ def main() -> None:
 
     orig_prefill = eng._prefill_group
     orig_dispatch = eng._dispatch_decode
-    orig_process = eng._process_block_host
+    orig_first = eng._emit_first_values
 
     def prefill_group(bucket, entries):
         marks.setdefault("admit", time.perf_counter())
@@ -71,21 +71,21 @@ def main() -> None:
             marks.setdefault("decode_dispatched", time.perf_counter())
         return out
 
-    # The scheduler's blocking fetch happens just before
-    # _process_block_host; fetch_end marks when the first
-    # post-decode-dispatch block lands on the host.
+    # r4: the first token is emitted from the async copy of the
+    # prefill-sampled tokens (engine._emit_ready_first_tokens), not
+    # from a decode-block fetch — emit_first is the stage to watch.
 
-    def process_block(fl, host_block):
-        if "decode_dispatched" in marks:
-            marks.setdefault("fetch_end", time.perf_counter())
-        return orig_process(fl, host_block)
+    def emit_first(vals, metas):
+        if "prefill_dispatched" in marks:
+            marks.setdefault("emit_first", time.perf_counter())
+        return orig_first(vals, metas)
 
     eng._prefill_group = prefill_group
     eng._dispatch_decode = dispatch_decode
-    eng._process_block_host = process_block
+    eng._emit_first_values = emit_first
 
     stages = ["admit", "prefill_dispatched", "decode_dispatched",
-              "fetch_end", "first_token"]
+              "emit_first", "first_token"]
     rows = []
     for r in range(n_req):
         marks.clear()
