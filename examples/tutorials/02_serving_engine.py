@@ -70,6 +70,45 @@ print("metrics:", engine.metrics.snapshot())
 engine.stop()
 
 # %% [markdown]
+# ## Speculative decoding
+# `speculative_k > 0` turns on greedy self-speculation: an on-device
+# n-gram drafter proposes k tokens from the sequence's own history and
+# ONE verify forward checks them — up to k+1 committed tokens per
+# weight read. Output is exactly the greedy continuation (acceptance
+# only changes speed); sampled requests are rejected at submit.
+
+# %%
+import dataclasses
+
+spec_engine = LLMEngine(params, cfg, ByteTokenizer(),
+                        dataclasses.replace(ecfg, speculative_k=2),
+                        use_pallas=False).start()
+prompt = [7, 8, 9]
+spec_out = [ev["token_id"] for ev in
+            spec_engine.generate_stream(prompt, max_new_tokens=12)
+            if ev["token_id"] >= 0]
+snap = spec_engine.metrics.snapshot()
+print("speculative tokens:", spec_out)
+print("committed tokens per verify step:",
+      round(snap.get("spec_tokens_per_step", 1.0), 2))
+spec_engine.stop()
+
+# Equality guarantee: same tokens as the plain greedy engine. (This
+# comparison is deterministic within one environment; across XLA
+# versions a random-weight near-tie could legitimately flip — see
+# docs/ENGINEERING_NOTES.md "honesty notes". If this assert ever
+# fails after a toolchain bump, check logit gaps before suspecting
+# the engine.)
+plain = LLMEngine(params, cfg, ByteTokenizer(), ecfg,
+                  use_pallas=False).start()
+plain_out = [ev["token_id"] for ev in
+             plain.generate_stream(prompt, max_new_tokens=12)
+             if ev["token_id"] >= 0]
+plain.stop()
+assert spec_out == plain_out, (spec_out, plain_out)
+print("speculative == greedy ✓")
+
+# %% [markdown]
 # ## Multi-chip
 # Under a `jax.sharding.Mesh` the same engine runs tensor-parallel:
 # `serving.sharding.shard_llama_params` + `LLMEngine(..., mesh=mesh)`.

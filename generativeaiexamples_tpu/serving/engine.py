@@ -194,6 +194,10 @@ class EngineMetrics:
         self.tokens_out = 0
         self.decode_steps = 0
         self.busy_slots_acc = 0
+        # Speculative decoding: committed tokens vs slot-steps, for the
+        # acceptance-rate gauge (1.0 = no drafts accepted, k+1 = all).
+        self.spec_committed = 0
+        self.spec_slot_steps = 0
         self.started = time.perf_counter()
         # (timestamp, n_tokens) per decode dispatch for the sliding rate.
         self._token_events: deque = deque(maxlen=8192)
@@ -231,13 +235,17 @@ class EngineMetrics:
         pct = lambda p: t[int(p * (len(t) - 1))] if t else None  # noqa: E731
         occ = (self.busy_slots_acc / self.decode_steps
                if self.decode_steps else 0.0)
-        return {
+        out = {
             "ttft_p50_ms": pct(0.5), "ttft_p95_ms": pct(0.95),
             "tokens_generated": self.tokens_out,
             "decode_steps": self.decode_steps,
             "mean_batch_occupancy": occ,
             "tokens_per_sec": self.tokens_per_sec(),
         }
+        if self.spec_slot_steps:
+            out["spec_tokens_per_step"] = (self.spec_committed
+                                           / self.spec_slot_steps)
+        return out
 
 
 class LLMEngine:
@@ -1420,6 +1428,8 @@ class LLMEngine:
                 slot.kv_len += emitted
                 slot.kv_worst -= fl.spec_worst
             block_emitted += emitted
+            self.metrics.spec_slot_steps += fl.K
+        self.metrics.spec_committed += block_emitted
         self.metrics.record_tokens(block_emitted)
 
     def _flush_first_for(self, slot: "_Slot") -> None:

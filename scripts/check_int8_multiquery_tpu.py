@@ -8,7 +8,10 @@ Run: python scripts/check_int8_multiquery_tpu.py
 
 from __future__ import annotations
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from generativeaiexamples_tpu.utils.platform import apply_platform_env
 
@@ -17,8 +20,6 @@ apply_platform_env()
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-sys.path.insert(0, "/root/repo")
 
 from generativeaiexamples_tpu.serving.paged_attention_int8 import (
     paged_attention_int8, paged_attention_int8_reference_fused)

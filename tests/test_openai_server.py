@@ -135,3 +135,34 @@ def test_embedding_engine_batching_order():
     got = eng.embed(texts)
     one_by_one = np.stack([eng.embed([t])[0] for t in texts])
     np.testing.assert_allclose(got, one_by_one, atol=1e-4)
+
+
+def test_speculative_engine_serving_surface():
+    """The OpenAI surface over a speculative engine: greedy requests
+    serve normally; sampled requests get an OpenAI-style 422 with an
+    actionable message (not a 500)."""
+    tk = ByteTokenizer()
+    llm = LLMEngine(
+        llama.init_params(TINY_LLM, jax.random.PRNGKey(0)), TINY_LLM, tk,
+        EngineConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                     prefill_buckets=(16,), speculative_k=2),
+        use_pallas=False).start()
+    try:
+        async def body(c):
+            ok = await c.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 5, "temperature": 0})
+            bad = await c.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 5, "temperature": 0.8})
+            return (ok.status, await ok.json(), bad.status,
+                    await bad.json())
+
+        s_ok, d_ok, s_bad, d_bad = _client_call((llm, None, None), body)
+        assert s_ok == 200
+        assert d_ok["usage"]["completion_tokens"] == 5
+        assert s_bad == 422
+        assert d_bad["error"]["code"] == "unsupported_parameter"
+        assert "speculative" in d_bad["error"]["message"]
+    finally:
+        llm.stop()

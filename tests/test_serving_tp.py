@@ -80,6 +80,33 @@ def test_tp8_int8_engine_matches_single_device(eight_dev_mesh):
     assert ref == got
 
 
+def test_tp8_speculative_engine_matches_single_device(eight_dev_mesh):
+    """Speculative decoding under TP: drafts/verify/history all ride
+    the mesh (flat verify path; the fused multi-query kernel is
+    single-device-only) and tokens must match the non-spec single-
+    device engine exactly — greedy is greedy."""
+    import dataclasses
+
+    cfg = tp_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    prompts = [list(range(2, 22)), [7, 8, 9]]
+    ref = run_engine(params, cfg, mesh=None, prompts=prompts)
+
+    spec_ecfg = dataclasses.replace(ECFG, speculative_k=2)
+    sharded = shd.shard_llama_params(params, cfg, eight_dev_mesh)
+    eng = LLMEngine(sharded, cfg, ByteTokenizer(), spec_ecfg,
+                    mesh=eight_dev_mesh).start()
+    try:
+        got = []
+        for p in prompts:
+            got.append([ev["token_id"]
+                        for ev in eng.generate_stream(p, max_new_tokens=12)
+                        if ev["token_id"] >= 0])
+    finally:
+        eng.stop()
+    assert ref == got
+
+
 def test_tp_with_data_axis(eight_dev_mesh):
     """Mixed layout (data=2, tensor=4): batch sharded on data, heads on
     tensor — the throughput-serving mesh."""
