@@ -423,6 +423,51 @@ class TestSpeculativeDecode:
         finally:
             eng.stop()
 
+    def test_stress_random_lengths_cancels_and_pool_reuse(self):
+        """Churn the speculative scheduler: random request lengths,
+        mid-stream cancellations, tight page pool. Every request must
+        terminate, token counts must be exact for uncancelled ones, and
+        every page must return to the allocator (the page-accounting
+        bug class the pipelined-sibling reconciliation fix addressed)."""
+        import random
+
+        rng = random.Random(0)
+        params = llama.init_params(TINY, jax.random.PRNGKey(0))
+        ecfg = EngineConfig(max_batch_size=3, max_seq_len=64, page_size=8,
+                            prefill_buckets=(16,),
+                            decode_steps_per_dispatch=4, speculative_k=2)
+        eng = LLMEngine(params, TINY, ByteTokenizer(), ecfg,
+                        use_pallas=False).start()
+        free0 = eng.allocator.n_free
+        try:
+            reqs = []
+            for i in range(12):
+                n = rng.choice([1, 2, 5, 9, 17, 30])
+                r = GenRequest(prompt_ids=[i % 7 + 1, 2, 3],
+                               max_new_tokens=n)
+                eng.submit(r)
+                if rng.random() < 0.25:
+                    r.cancelled = True
+                reqs.append((r, n))
+            for r, n in reqs:
+                toks = 0
+                while True:
+                    ev = r.stream.get(timeout=60)
+                    if ev["token_id"] >= 0:
+                        toks += 1
+                    if ev["finished"]:
+                        break
+                if not r.cancelled:
+                    assert toks == n, (toks, n)
+            # Drain in-flight blocks (parked releases) then check pages.
+            deadline = time.time() + 20
+            while eng.allocator.n_free != free0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert eng.allocator.n_free == free0, \
+                (eng.allocator.n_free, free0)
+        finally:
+            eng.stop()
+
     def test_repetitive_sequence_accepts_drafts(self):
         """A prompt whose greedy continuation enters a cycle must see
         n-gram drafts accepted (tokens-per-step > 1) — the mechanism's
