@@ -463,7 +463,11 @@ class LLMEngine:
         flag_sets = [(True, False, False)]
         if sampled:
             flag_sets.append((False, True, True))
-        key = jax.random.PRNGKey(0)
+        # Every live dispatch draws from _next_key() — jax.random.split
+        # has its own tiny jit graphs (split/_unstack) that would
+        # otherwise compile on the scheduler thread at the first real
+        # request (caught by the zero-compile subprocess test).
+        key = self._next_key()
         for bucket in (buckets or self.buckets):
             for n in group_sizes:
                 for flags in flag_sets:
@@ -538,10 +542,11 @@ class LLMEngine:
                                  for s in long_prompt_lengths})
             else:
                 s_tots = list(range(chunk, self.max_pages * ps + 1, chunk))
+            logits = None
             for s_tot in s_tots:
                 cache = KVCache.zeros(self.cfg, 1, max_len=s_tot)
                 cache = self._place_scratch_cache(cache)
-                _, cache = engine_model.prefill_chunk_step(
+                logits, cache = engine_model.prefill_chunk_step(
                     self.params, self.cfg, cache,
                     self._put(np.zeros((1, chunk), np.int32)),
                     self._put(np.int32(1)), self.use_pallas,
@@ -549,6 +554,20 @@ class LLMEngine:
                 self.pool = engine_model.cache_to_pool(
                     self.pool, cache, self.cfg,
                     self._put(np.zeros((s_tot // ps,), np.int32)))
+            if logits is not None:
+                # The chunked-prefill FINISH path samples through its
+                # own jit variants (sample_token / set_last_token),
+                # distinct from the batched-prefill graph. Cold, they
+                # compile on the scheduler thread mid-request — the r4
+                # 2k-TTFT run-to-run instability (361 vs 1289 ms) was
+                # this, visible only when the persistent compile cache
+                # didn't already hold them.
+                tok0 = None
+                for flags in flag_sets:
+                    tok0 = engine_model.sample_token(
+                        logits, 0.0, 1.0, 0, key, *flags)
+                self._last_tokens = engine_model.set_last_token(
+                    self._last_tokens, self._put(np.int32(0)), tok0)
         jax.block_until_ready(self._last_tokens)
         _LOG.info("engine warmup: %d prefill + %d decode variants compiled",
                   len(self.buckets if buckets is None else buckets)
@@ -1099,7 +1118,7 @@ class LLMEngine:
             if s.req.cancelled:
                 self._finish(i, "cancelled")
                 continue
-            cap = self.max_pages * self.pool.page_size - s.seq.length
+            cap, _ = self._advance_capacity(s, s.seq.length)
             if cap < 1:
                 self._starve(i)
                 continue
@@ -1128,8 +1147,8 @@ class LLMEngine:
         # requested token are dropped at emission) — round UP to the
         # nearest precompiled K rather than shrink onto a cold variant
         # that would freeze every stream behind a 20-40 s compile.
-        cap_steps = min(self.max_pages * self.pool.page_size
-                        - self.slots[i].seq.length for i in live)
+        cap_steps = min(self._advance_capacity(
+            self.slots[i], self.slots[i].seq.length)[0] for i in live)
         max_rem = max(self.slots[i].req.max_new_tokens
                       - self.slots[i].scheduled for i in live)
         K = self._pick_k(min(K, max(1, cap_steps)))
@@ -1158,20 +1177,21 @@ class LLMEngine:
                 try:
                     s.seq.ensure(base_len + K)
                 except MemoryError:
-                    # Pool exhausted. Only finish the slot if it cannot
-                    # advance even one token within its allocated pages;
-                    # otherwise shrink K so it (and everyone) continues
-                    # within existing allocations.
-                    in_page_cap = len(s.seq.pages) * self.pool.page_size \
-                        - base_len
-                    if in_page_cap >= 1 and K > 1:
-                        shrink_to = max(1, in_page_cap)
+                    # Pool can't cover K steps. Shrink K to what the
+                    # slot's allocated pages PLUS the remaining free
+                    # pages can hold (avail >= 1 guarantees ensure at
+                    # the shrunken K succeeds); starve only when even
+                    # one token cannot be stored anywhere.
+                    _, avail = self._advance_capacity(s, base_len)
+                    if avail >= 1 and K > 1:
+                        shrink_to = max(1, avail)
                         break
-                    if in_page_cap < 1:
+                    if avail < 1:
                         self._starve(i)
                     continue
                 active.append(i)
                 active_mask[i] = True
+                s.no_capacity = False  # capacity proven; undo stale starve
                 lengths[i] = base_len + 1  # incl. the incoming token
                 tables[i] = s.seq.table_row()
                 temps[i] = s.req.temperature
@@ -1237,8 +1257,7 @@ class LLMEngine:
             # A verify step writes k/v for up to r positions; a slot
             # without r tokens of page capacity sits the block out (and
             # is finished with "length" once its in-flight work drains).
-            cap = self.max_pages * self.pool.page_size \
-                - (s.kv_len + s.kv_worst)
+            cap, _ = self._advance_capacity(s, s.kv_len + s.kv_worst)
             if cap < r:
                 self._starve(i)
                 continue
@@ -1249,9 +1268,10 @@ class LLMEngine:
             return False
         if len(live) * 4 <= B:
             steps = min(steps, 2)  # same low-occupancy latency regime
-        cap_steps = min((self.max_pages * self.pool.page_size
-                         - (self.slots[i].kv_len + self.slots[i].kv_worst))
-                        // r for i in live)
+        cap_steps = min(self._advance_capacity(
+            self.slots[i],
+            self.slots[i].kv_len + self.slots[i].kv_worst)[0] // r
+            for i in live)
         max_rem = max(self.slots[i].req.max_new_tokens
                       - self.slots[i].scheduled for i in live)
         steps = self._pick_k(min(steps, max(1, cap_steps)))
@@ -1277,16 +1297,20 @@ class LLMEngine:
                 try:
                     s.seq.ensure(bound + worst)
                 except MemoryError:
-                    in_page_cap = len(s.seq.pages) * self.pool.page_size \
-                        - bound
-                    if in_page_cap >= r and steps > 1:
-                        shrink_to = max(1, in_page_cap // r)
+                    # Same shrink rule as the plain path, in units of r
+                    # (each verify step stores up to r positions): count
+                    # free pool pages too, so a slot whose growth must
+                    # come from the pool shrinks instead of starving.
+                    _, avail = self._advance_capacity(s, bound)
+                    if avail >= r and steps > 1:
+                        shrink_to = max(1, avail // r)
                         break
-                    if in_page_cap < r:
+                    if avail < r:
                         self._starve(i)
                     continue
                 active.append(i)
                 active_mask[i] = True
+                s.no_capacity = False  # capacity proven; undo stale starve
                 tables[i] = s.seq.table_row()
                 metas.append((i, s, bound))
             if shrink_to is None:
@@ -1333,6 +1357,17 @@ class LLMEngine:
             k = max(w for w in self._warm_ks if w <= k)
         return k
 
+    def _advance_capacity(self, slot: "_Slot", used: int):
+        """(table_cap, avail): tokens this slot can still store against
+        the page-table limit, and against its allocated pages PLUS the
+        pool's current free pages. One definition shared by both
+        dispatch paths and _reap_starved — three hand-rolled copies of
+        this arithmetic is how starve/finish divergence happens."""
+        ps = self.pool.page_size
+        table_cap = self.max_pages * ps - used
+        in_page = len(slot.seq.pages) * ps - used
+        return table_cap, in_page + self.allocator.n_free * ps
+
     def _starve(self, slot_idx: int) -> None:
         """The dispatcher can't advance this slot. If blocks are still in
         flight for it, its remaining tokens (possibly incl. a legitimate
@@ -1350,12 +1385,27 @@ class LLMEngine:
             self._finish(slot_idx, "length")
 
     def _reap_starved(self) -> None:
+        """Finish slots that were starved of page capacity AND still
+        cannot advance now that their in-flight blocks have drained.
+        Capacity can come back between the starve and the drain — a
+        speculative landing refunds its worst-case reservation
+        (kv_worst -= spec_worst in _process_spec_block) and retiring
+        slots free pool pages — so finishing unconditionally here would
+        truncate streams with reason "length" while pages are free."""
+        r = (self._spec_k + 1) if self._spec_k else 1
         for i, slot in enumerate(self.slots):
             if slot is None or not slot.no_capacity:
                 continue
-            if not any(s is slot for fl in self._inflight
-                       for _, s, _ in fl.metas):
-                self._finish(i, "length")
+            if any(s is slot for fl in self._inflight
+                   for _, s, _ in fl.metas):
+                continue
+            used = (slot.kv_len + slot.kv_worst) if self._spec_k \
+                else slot.seq.length
+            table_cap, avail = self._advance_capacity(slot, used)
+            if table_cap >= r and avail >= r:
+                slot.no_capacity = False
+                continue
+            self._finish(i, "length")
 
     def _process_block_host(self, fl: _InFlight, block) -> None:
         """Emit/finish slots from a block already fetched to the host
