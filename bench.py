@@ -165,6 +165,10 @@ def main() -> None:
         with lock:
             results.append((n, first))
 
+    # Phase boundary: the sliding-window gauge must cover ONLY the
+    # burst (the idle gap after the warmup smoke otherwise stretches
+    # its span and under-reads ~8% — r4 VERDICT weak #6).
+    eng.metrics.reset_window()
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker) for _ in range(batch)]
     for t in threads:
@@ -175,6 +179,10 @@ def main() -> None:
 
     total_tokens = sum(n for n, _ in results)
     ttfts = sorted(f for _, f in results if f is not None)
+    # Headline = total_tokens / wall (job throughput: includes the
+    # prefill ramp and final drain). engine_metrics.tokens_per_sec =
+    # the engine's live sliding-window gauge over the same burst
+    # (emission-event span only) — reads slightly higher by design.
     snap = eng.metrics.snapshot()
 
     # TTFT under REALISTIC load: 16 requests arriving staggered over
@@ -275,6 +283,12 @@ def main() -> None:
             if single_ttfts else None,
             "engine_metrics": {k: (round(v, 2) if isinstance(v, float) else v)
                                for k, v in snap.items()},
+            "throughput_provenance": (
+                "headline value = total_tokens/wall over the burst "
+                "(job throughput incl. prefill ramp + drain); "
+                "engine_metrics.tokens_per_sec = engine sliding-window "
+                "gauge over the same burst's emission events only — "
+                "expected to read slightly above the headline"),
             "backend": jax.default_backend(),
             **longctx_stats,
             **encoder_stats,

@@ -95,6 +95,10 @@ def get_embedder(config: AppConfig, hub: Optional[EngineHub] = None):
         from generativeaiexamples_tpu.connectors.fakes import HashEmbedder
 
         return HashEmbedder(dim=config.embeddings.dimensions)
+    if eng in ("lexical", "tfidf", "bm25"):
+        from generativeaiexamples_tpu.connectors.lexical import LexicalEmbedder
+
+        return LexicalEmbedder(dim=max(config.embeddings.dimensions, 1024))
     if eng in ("openai", "nim", "remote") or (config.embeddings.server_url and
                                               eng != "tpu"):
         from generativeaiexamples_tpu.connectors.openai_http import (

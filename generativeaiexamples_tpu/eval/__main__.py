@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 
 _LOG = logging.getLogger(__name__)
@@ -29,7 +30,10 @@ _LOG = logging.getLogger(__name__)
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--docs", nargs="+", required=True,
-                    help="corpus files to evaluate over")
+                    help="corpus files (or directories of files) to "
+                         "evaluate over — directories expand non-"
+                         "recursively, as the compose eval service "
+                         "mounts the corpus at /corpus")
     ap.add_argument("--server", default="http://localhost:8081",
                     help="chain server base URL")
     ap.add_argument("--offline", action="store_true",
@@ -48,6 +52,14 @@ def main() -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    args.docs = [f for p in args.docs
+                 for f in (sorted(
+                     os.path.join(p, e) for e in os.listdir(p)
+                     if os.path.isfile(os.path.join(p, e)))
+                     if os.path.isdir(p) else [p])]
+    if not args.docs:
+        print("no corpus files found", file=sys.stderr)
+        return 1
 
     from generativeaiexamples_tpu.config.wizard import load_config
     from generativeaiexamples_tpu.connectors import factory
@@ -57,11 +69,14 @@ def main() -> int:
 
     cfg = load_config(None)
     if args.offline:
-        from generativeaiexamples_tpu.connectors.fakes import (
-            EchoLLM, HashEmbedder)
+        from generativeaiexamples_tpu.connectors.fakes import EchoLLM
+        from generativeaiexamples_tpu.connectors.lexical import (
+            LexicalEmbedder)
 
-        # Scripted fakes: enough structure to exercise all four stages
-        # (patterns match the ACTUAL harness/metrics prompts).
+        # Scripted fake LLM: enough structure to exercise all four
+        # stages (patterns match the ACTUAL harness/metrics prompts).
+        # The embedder is NOT a fake — lexical TF-IDF retrieval is the
+        # real model-free retrieval path the retrieval metrics measure.
         llm = EchoLLM(script=[
             ("question-answer pair",
              '{"question": "What does the passage describe?", '
@@ -69,7 +84,7 @@ def main() -> int:
             ("You are grading answers",
              '{"rating": 4, "explanation": "close to the reference"}'),
         ])
-        embedder = HashEmbedder(64)
+        embedder = LexicalEmbedder(1024)
     else:
         llm, embedder = factory.get_llm(cfg), factory.get_embedder(cfg)
 

@@ -119,13 +119,16 @@ def generate_answers(client: ChainServerClient, qa_rows: Sequence[Dict],
 
 def run_eval(llm, embedder, dataset: Sequence[Dict],
              judge_llm=None) -> Dict:
-    """03+04: metric suite + judge; returns the combined report."""
+    """03+04: metric suite + judge + model-free retrieval metrics;
+    returns the combined report."""
     from generativeaiexamples_tpu.eval.metrics import (
-        RagasEvaluator, eval_llm_judge)
+        RagasEvaluator, eval_llm_judge, eval_retrieval)
 
     ragas = RagasEvaluator(llm, embedder).evaluate(dataset)
     judge = eval_llm_judge(judge_llm or llm, dataset)
-    return {"ragas": ragas, "llm_judge": judge, "n": len(dataset)}
+    retrieval = eval_retrieval(dataset)
+    return {"ragas": ragas, "llm_judge": judge, "retrieval": retrieval,
+            "n": len(dataset)}
 
 
 def save_report(report: Dict, path: str) -> None:

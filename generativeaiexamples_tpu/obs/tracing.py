@@ -335,6 +335,39 @@ class _NullSpan:
         pass
 
 
+def get_system_metrics() -> Dict[str, float]:
+    """Host CPU/memory snapshot attached to every span at end — parity
+    with the reference's psutil block
+    (tools/observability/langchain/opentelemetry_callback.py:65-102).
+    psutil when available; a resource-module fallback keeps a stable
+    subset of the attribute set otherwise."""
+    try:
+        import psutil
+
+        proc = psutil.Process()
+        with proc.oneshot():
+            mem = proc.memory_info()
+            return {
+                "system.cpu_percent": psutil.cpu_percent(interval=None),
+                "system.process_cpu_percent": proc.cpu_percent(interval=None),
+                "system.memory_rss_mb": round(mem.rss / 1e6, 1),
+                "system.memory_vms_mb": round(mem.vms / 1e6, 1),
+                "system.memory_percent": psutil.virtual_memory().percent,
+            }
+    except Exception:
+        try:
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            return {  # ru_maxrss is KiB on Linux
+                "system.memory_rss_mb": round(ru.ru_maxrss / 1e3, 1),
+                "system.cpu_user_s": round(ru.ru_utime, 3),
+                "system.cpu_sys_s": round(ru.ru_stime, 3),
+            }
+        except Exception:
+            return {}
+
+
 class ManualSpan:
     """Explicitly started/ended span for code that crosses threads (the
     engine scheduler opens one at prefill and ends it at slot retire —
@@ -361,6 +394,11 @@ class ManualSpan:
 
     def end(self) -> None:
         if self._span is not None:
+            for k, v in get_system_metrics().items():
+                try:
+                    self._span.set_attribute(k, v)
+                except Exception:
+                    break
             self._span.end()
             self._span = None
 

@@ -299,16 +299,27 @@ class PgVectorStore:
         conn.query("SET standard_conforming_strings = on")
         return conn
 
-    def _q(self, sql: str):
+    def _q(self, sql: str, retry: bool = True):
+        """One reconnect-and-retry on a lost connection: a restarted or
+        idle-timed-out server must not permanently break the store (the
+        Milvus peer reconnects per-request by construction).
+
+        retry=False for non-idempotent statements (INSERT): the
+        connection can die AFTER the server executed the statement but
+        before the response was read — a blind retry would duplicate
+        rows (duplicate chunks then get served as context). Those
+        reconnect for subsequent calls but surface the failure."""
         with self._lock:
             try:
                 return self._conn.query(sql)
-            except PgConnectionLost:
-                # One reconnect-and-retry: a restarted/idle-timed-out
-                # server must not permanently break the store (the
-                # Milvus peer reconnects per-request by construction).
+            except PgConnectionLost as e:
                 _LOG.warning("pgvector connection lost; reconnecting")
                 self._conn = self._connect()
+                if not retry:
+                    raise PgError(
+                        "connection lost during a non-idempotent "
+                        "statement; not retried (the server may have "
+                        "applied it)") from e
                 return self._conn.query(sql)
 
     def _ensure_table(self) -> None:
@@ -339,7 +350,7 @@ class PgVectorStore:
         rows, _ = self._q(
             f"INSERT INTO {_ident(self.table)} "
             f"(embedding, text, filename, meta) VALUES {values} "
-            f"RETURNING id")
+            f"RETURNING id", retry=False)
         return [int(r[0]) for r in rows]
 
     def search(self, query_embedding: np.ndarray, top_k: int = 4,

@@ -211,16 +211,28 @@ class PlaygroundServer:
 
         rate = self.voice_sample_rate
         buf: list = []
+        n_buffered = 0
         n_at_last = 0
         interim_s = float(os.environ.get("VOICE_INTERIM_INTERVAL_S", "0.5"))
+        # Bounded take: a client that streams without ever sending
+        # {"end": true} must not grow server memory without limit (16 MB
+        # per frame is allowed), and each interim pass re-transcribes
+        # the accumulation — so cap the take and window the interim.
+        max_take_s = float(os.environ.get("VOICE_MAX_TAKE_S", "300"))
+        interim_window_s = float(
+            os.environ.get("VOICE_INTERIM_WINDOW_S", "30"))
+        cap_notified = False
         last_interim = 0.0
         interim_task: "asyncio.Task | None" = None
 
-        def _pcm():
+        def _pcm(window_s: "float | None" = None):
             import numpy as np
 
-            return (np.concatenate(buf) if buf
-                    else np.zeros((0,), "int16"))
+            pcm = (np.concatenate(buf) if buf
+                   else np.zeros((0,), "int16"))
+            if window_s is not None:
+                pcm = pcm[-int(window_s * rate):]
+            return pcm
 
         async def send_interim(snapshot):
             try:
@@ -240,15 +252,25 @@ class PlaygroundServer:
                         {"error": "binary frames must be int16 PCM "
                                   "(even byte length)"})
                     continue
-                buf.append(np.frombuffer(msg.data, "<i2"))
+                arr = np.frombuffer(msg.data, "<i2")
+                if n_buffered + len(arr) > max_take_s * rate:
+                    if not cap_notified:
+                        cap_notified = True
+                        await ws.send_json(
+                            {"error": f"take exceeds {max_take_s:.0f}s "
+                                      "cap; send {\"end\": true} to "
+                                      "finalize the buffered audio"})
+                    continue
+                buf.append(arr)
+                n_buffered += len(arr)
                 now = _time.monotonic()
-                grown = sum(len(c) for c in buf) > n_at_last
-                if (grown and now - last_interim >= interim_s
+                if (n_buffered > n_at_last
+                        and now - last_interim >= interim_s
                         and (interim_task is None or interim_task.done())):
                     last_interim = now
-                    n_at_last = sum(len(c) for c in buf)
+                    n_at_last = n_buffered
                     interim_task = asyncio.create_task(
-                        send_interim(_pcm()))
+                        send_interim(_pcm(window_s=interim_window_s)))
             elif msg.type == web.WSMsgType.TEXT:
                 try:
                     data = json.loads(msg.data)

@@ -147,8 +147,33 @@ def load_tokenizer(name_or_path: str):
                 f"no tokenizer.json under {name_or_path!r} (and no model "
                 f"weights found there to justify a byte-tokenizer "
                 f"fallback)")
+        # The byte tokenizer can only meaningfully decode byte-sized
+        # vocabularies. Serving a real-vocab model (e.g. llama's 128k)
+        # through it would boot fine and emit mojibake — a deployment
+        # error hidden behind a log line. Gate on the checkpoint's own
+        # config.json vocab_size, with an explicit env escape hatch.
+        vocab = None
+        cfg_path = os.path.join(name_or_path, "config.json")
+        if os.path.isfile(cfg_path):
+            import json
+
+            try:
+                with open(cfg_path) as fh:
+                    vocab = json.load(fh).get("vocab_size")
+            except (OSError, ValueError):
+                vocab = None
+        byte_ok = vocab is not None and vocab <= 512
+        if not byte_ok and os.environ.get(
+                "GAIE_BYTE_TOKENIZER_FALLBACK", "0") != "1":
+            raise FileNotFoundError(
+                f"no tokenizer.json under {name_or_path!r}, and its "
+                f"config.json vocab_size ({vocab}) is not byte-"
+                f"compatible (<= 512) — serving it through the byte "
+                f"tokenizer would produce garbage text. Provide the "
+                f"tokenizer, or set GAIE_BYTE_TOKENIZER_FALLBACK=1 to "
+                f"override knowingly.")
         logging.getLogger(__name__).warning(
             "weights-only checkpoint %s has no tokenizer.json; using the "
-            "byte tokenizer", name_or_path)
+            "byte tokenizer (vocab_size=%s)", name_or_path, vocab)
         return ByteTokenizer()
     return HFTokenizer(name_or_path)

@@ -96,7 +96,7 @@ def main() -> int:
                      APP_LLM_MODELENGINE="openai",
                      APP_LLM_SERVERURL=f"http://127.0.0.1:{SERVE_PORT}/v1",
                      APP_LLM_MODELNAME="tiny-llama-seeded",
-                     APP_EMBEDDINGS_MODELENGINE="hash",
+                     APP_EMBEDDINGS_MODELENGINE="lexical",
                      PYTHONPATH=_CHILD_PYTHONPATH)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "generativeaiexamples_tpu.api.server",
@@ -123,18 +123,28 @@ def main() -> int:
                        "TPU v5e chip",
              "--note", "grader/judge: the same served tiny model; judge "
                        "JSON parse failures count as unrated (None)",
-             "--note", "retrieval embedder: deterministic HashEmbedder "
-                       "(lexical); real BERT weights face the same "
-                       "download limitation"],
+             "--note", "retrieval embedder: LexicalEmbedder (hashed "
+                       "TF-IDF, model-free) — real lexical retrieval; "
+                       "dense BERT weights face the same download "
+                       "limitation",
+             "--note", "the ragas context_*/faithfulness/answer_* "
+                       "metrics are LLM-GRADED: with the seeded random-"
+                       "weight judge they read 0/null by construction "
+                       "and say nothing about retrieval. Retrieval "
+                       "quality is measured WITHOUT an LLM in the "
+                       "'retrieval' section (hit@k / MRR vs each "
+                       "question's ground_truth_context)."],
             cwd=ROOT, env=env_b)
         print(f"[eval-tpu] eval CLI rc={cli.returncode}; report at {out}")
         if cli.returncode == 0:
             with open(out) as fh:
                 rep = json.load(fh)
             qs = [r["question"] for r in rep.get("rows", [])]
-            assert len(set(qs)) == len(qs) and len(qs) >= 8, \
-                "expected distinct questions"
+            assert len(set(qs)) == len(qs) and len(qs) >= 20, \
+                "expected >= 20 distinct questions"
+            assert rep["retrieval"]["n_scored"] >= 20, rep["retrieval"]
             print(json.dumps({"ragas": rep["ragas"],
+                              "retrieval": rep["retrieval"],
                               "judge": rep["llm_judge"].get("mean_rating"),
                               "distinct_questions": len(set(qs))}, indent=2))
         return cli.returncode

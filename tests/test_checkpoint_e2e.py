@@ -132,3 +132,36 @@ class TestCheckpointToEngine:
         q2 = _engine_tokens(qparams, qcfg, kv_dtype="int8", n=8)
         assert q1 == q2  # deterministic
         assert q1[0] == fp[0]  # first step agrees at tiny scale
+
+
+def test_byte_tokenizer_fallback_gated_on_vocab_size(tmp_path):
+    """ADVICE r4: a weights-only checkpoint only falls back to the byte
+    tokenizer when its config.json vocab_size is byte-compatible —
+    serving a real-vocab model through it would hide a deployment
+    error behind mojibake output."""
+    import json
+
+    import pytest
+
+    from generativeaiexamples_tpu.utils.tokenizer import (
+        ByteTokenizer, load_tokenizer)
+
+    # Byte-compatible seeded snapshot: fallback allowed.
+    small = tmp_path / "small"
+    write_tiny_hf_checkpoint(str(small))
+    assert isinstance(load_tokenizer(str(small)), ByteTokenizer)
+
+    # Real-vocab checkpoint without a tokenizer: fail loudly...
+    big = tmp_path / "big"
+    big.mkdir()
+    (big / "model.safetensors").write_bytes(b"\0" * 8)
+    (big / "config.json").write_text(json.dumps({"vocab_size": 128256}))
+    with pytest.raises(FileNotFoundError, match="byte-compatible"):
+        load_tokenizer(str(big))
+
+    # ...unless explicitly overridden.
+    os.environ["GAIE_BYTE_TOKENIZER_FALLBACK"] = "1"
+    try:
+        assert isinstance(load_tokenizer(str(big)), ByteTokenizer)
+    finally:
+        del os.environ["GAIE_BYTE_TOKENIZER_FALLBACK"]

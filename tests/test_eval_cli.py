@@ -28,3 +28,38 @@ def test_offline_eval_cli(tmp_path):
     assert set(row) >= {"question", "generated_answer",
                         "retrieved_context", "ground_truth_answer"}
     assert "ragas" in report and "llm_judge" in report
+    # synthetic QA carries ground_truth_context, so the model-free
+    # retrieval section scores every row (VERDICT r4 #3)
+    assert report["retrieval"]["n_scored"] == summary["n_questions"]
+    assert report["retrieval"]["hit_at_k"] is not None
+
+
+def test_eval_cli_expands_docs_directory(tmp_path):
+    """--docs accepts a directory (the compose eval service mounts the
+    corpus at /corpus)."""
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    (corpus / "a.txt").write_text(
+        "Ring attention rotates key and value blocks over ICI links.")
+    (corpus / "b.txt").write_text(
+        "The paged KV cache stores int8 codes with narrow scales.")
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "generativeaiexamples_tpu.eval",
+         "--docs", str(corpus), "--offline", "--max-pairs", "2",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=ROOT, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(out.read_text())
+    assert report["n"] >= 1
+
+
+def test_evaluation_compose_file_parses():
+    import yaml
+
+    with open(os.path.join(ROOT, "deploy", "compose",
+                           "evaluation.yaml")) as fh:
+        doc = yaml.safe_load(fh)
+    svc = doc["services"]["evaluation"]
+    assert "generativeaiexamples_tpu.eval" in svc["command"]
+    assert any("/corpus" in v for v in svc["volumes"])

@@ -190,8 +190,21 @@ def test_engine_emits_generation_spans():
         assert sp.attributes["prompt_tokens"] == 3
         assert sp.attributes["tokens_generated"] == 4
         assert any(e.name == "first_token" for e in sp.events)
+        # System metrics ride every span end (reference parity:
+        # opentelemetry_callback.py:65-102 psutil block).
+        assert sp.attributes["system.memory_rss_mb"] > 0
+        assert "system.cpu_percent" in sp.attributes or \
+            "system.cpu_user_s" in sp.attributes
     finally:
         tracing._ENABLED = False
+
+
+def test_span_system_metrics_snapshot():
+    from generativeaiexamples_tpu.obs.tracing import get_system_metrics
+
+    m = get_system_metrics()
+    assert m["system.memory_rss_mb"] > 0
+    assert any(k.startswith("system.cpu") for k in m)
 
 
 def test_compile_cache_configured(tmp_path):
