@@ -137,6 +137,7 @@ def forward(
     lengths: Optional[jax.Array] = None,  # [B] valid tokens (padding mask)
     token_types: Optional[jax.Array] = None,
     use_pallas: Optional[bool] = None,
+    interpret: bool = False,  # Pallas interpret mode (CPU tests)
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (hidden [B,S,D], pooled [B,D] or scores [B,n_labels])."""
     B, S = tokens.shape
@@ -155,7 +156,7 @@ def forward(
         k = (h @ w["wk"] + w["bk"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
         v = (h @ w["wv"] + w["bv"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
         out = attn_ops.attention(q, k, v, causal=False, lengths=lengths,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, interpret=interpret)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
         x = layer_norm(attn_in + out @ w["wo"] + w["bo"],
                        w["ln1_w"], w["ln1_b"], cfg.ln_eps)

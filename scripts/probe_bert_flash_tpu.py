@@ -1,0 +1,90 @@
+"""On-chip probe: BERT encoder forward with flash attention vs the XLA
+reference path (VERDICT r4 #4 — encoder forward-level levers).
+
+Hypothesis: `ops.attention.on_tpu()` returns False on the axon-tunnel
+platform (backend name "axon", not "tpu"), so the encoder engines have
+been running `mha_reference` on the real chip — materializing the
+[B, H, S, S] score tensor through HBM (~1 GB/layer of traffic for
+BERT-large at B=32, S=512, ~24 GB per forward at 290 GB/s ≈ 80 ms of
+the ~180 ms measured batch time). The flash kernel never materializes
+scores.
+
+Measures, at arctic-embed-l geometry (bf16, B in {16, 32}, S=512):
+  [1] numerics: pooled-output max |Δ| flash vs reference
+  [2] wall time per forward (full host readback timing — the tunnel's
+      block_until_ready is unreliable, ENGINEERING_NOTES platform facts)
+
+Run (serialize with other chip users): PYTHONPATH=/root/repo python
+scripts/probe_bert_flash_tpu.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from generativeaiexamples_tpu.utils.platform import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from generativeaiexamples_tpu.models import bert  # noqa: E402
+
+
+def timed(fn, *args, reps=5):
+    out = fn(*args)
+    np.asarray(out)  # warm + full readback
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times), out
+
+
+def main() -> int:
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    from generativeaiexamples_tpu.ops import attention as attn_ops
+
+    print(f"on_tpu()={attn_ops.on_tpu()} (the dispatch default)")
+
+    cfg = dataclasses.replace(bert.BertConfig.arctic_embed_l(),
+                              dtype=jnp.bfloat16)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    S = 512
+    rng = np.random.default_rng(0)
+    for B in (16, 32):
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
+                             jnp.int32)
+        lengths = jnp.asarray(rng.integers(200, S + 1, (B,)), jnp.int32)
+
+        ref = jax.jit(lambda p, t, l: bert.forward(
+            p, cfg, t, lengths=l, use_pallas=False)[1])
+        fl = jax.jit(lambda p, t, l: bert.forward(
+            p, cfg, t, lengths=l, use_pallas=True)[1])
+
+        t_ref, o_ref = timed(ref, params, tokens, lengths)
+        try:
+            t_fl, o_fl = timed(fl, params, tokens, lengths)
+        except Exception as e:
+            print(f"B={B}: flash path FAILED: {type(e).__name__}: "
+                  f"{str(e)[:300]}")
+            continue
+        diff = float(jnp.max(jnp.abs(o_ref.astype(jnp.float32)
+                                     - o_fl.astype(jnp.float32))))
+        print(f"B={B}: ref {t_ref*1e3:.1f} ms  flash {t_fl*1e3:.1f} ms "
+              f"({t_ref/t_fl:.2f}x)  max|Δpooled|={diff:.2e}  "
+              f"docs/s ref={B/t_ref:.1f} flash={B/t_fl:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -150,3 +150,51 @@ class TestFlashDispatchGaps:
                              q_offset=off, use_pallas=True, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5)
+
+
+class TestFlashEncoderShapes:
+    """The encoder path (bidirectional, lengths-masked, head_dim 64 —
+    BERT-large) must be expressible through the flash kernel: the
+    VERDICT r4 #4 lever is moving encoders off the score-materializing
+    reference path."""
+
+    def test_noncausal_lengths_head64_matches_reference(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from generativeaiexamples_tpu.ops.attention import (
+            flash_attention, mha_reference)
+
+        B, H, D, S = 2, 4, 64, 128
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (B, H, S, D), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(key, 1), (B, H, S, D))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (B, H, S, D))
+        lengths = jnp.array([77, 128], jnp.int32)
+        want = mha_reference(q, k, v, causal=False, lengths=lengths)
+        got = flash_attention(q, k, v, causal=False, lengths=lengths,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_bert_forward_flash_matches_reference_path(self):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from generativeaiexamples_tpu.models import bert
+
+        cfg = dataclasses.replace(bert.BertConfig.tiny(), max_position=128)
+        params = bert.init_params(cfg, jax.random.PRNGKey(1))
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (3, 128), 0,
+                                    cfg.vocab_size)
+        lengths = jnp.array([50, 128, 9], jnp.int32)
+        _, ref = bert.forward(params, cfg, tokens, lengths=lengths,
+                              use_pallas=False)
+        _, fl = bert.forward(params, cfg, tokens, lengths=lengths,
+                             use_pallas=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(ref),
+                                   atol=2e-4)
