@@ -416,6 +416,10 @@ def _bench_encoders():
     bparams = bert.init_params(bcfg, jax.random.PRNGKey(0))
     # Buckets: short queries (prefix + ~50 chars ≈ 95 byte-tokens) must
     # not ride the 512 document bucket — the 128 bucket is ~4x cheaper.
+    # B=32: with the grouped encoder-attention kernel the per-doc
+    # forward cost is LOWER at 32 than 64 (attention VMEM pressure;
+    # decompose_bert_forward.py) and readback overlap hides the extra
+    # batch boundaries.
     emb = EmbeddingEngine(bparams, bcfg, ByteTokenizer(), max_batch=32,
                           buckets=(64, 128, 512))
     # Documents: reference-default chunk geometry (~510 tokens,
@@ -439,7 +443,7 @@ def _bench_encoders():
     rcfg = dataclasses.replace(bert.BertConfig.reranker_base(),
                                dtype=jnp.bfloat16)
     rparams = bert.init_params(rcfg, jax.random.PRNGKey(1))
-    rr = RerankEngine(rparams, rcfg, ByteTokenizer(), max_batch=16,
+    rr = RerankEngine(rparams, rcfg, ByteTokenizer(), max_batch=64,
                       buckets=(512,))
     passages = [mktext(400) for _ in range(128)]
     rr.score("warmup query", passages[:16])

@@ -122,6 +122,24 @@ def param_specs(cfg: BertConfig, rules: dict = LLM_RULES) -> Params:
     return specs
 
 
+def fuse_qkv_params(params: Params) -> Params:
+    """One-time QKV weight fusion: replace wq/wk/wv (and biases) with
+    the concatenated [L, D, 3D] wqkv forward() projects with. Engines
+    call this at init so the fusion is not a per-forward HBM transient
+    (~150 MB for BERT-large bf16). Idempotent; loaders/checkpoints keep
+    the split layout."""
+    lw = params["layers"]
+    if "wqkv" in lw:
+        return params
+    import jax.numpy as jnp
+
+    fused = {k_: v_ for k_, v_ in lw.items()
+             if k_ not in ("wq", "wk", "wv", "bq", "bk", "bv")}
+    fused["wqkv"] = jnp.concatenate([lw["wq"], lw["wk"], lw["wv"]], axis=-1)
+    fused["bqkv"] = jnp.concatenate([lw["bq"], lw["bk"], lw["bv"]], axis=-1)
+    return {**params, "layers": fused}
+
+
 def layer_norm(x, w, b, eps):
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
@@ -150,13 +168,45 @@ def forward(
     if lengths is None:
         lengths = jnp.full((B,), S, jnp.int32)
 
+    # Fused QKV projection: one [D, 3D] matmul per layer instead of
+    # three [D, D] — fewer, larger MXU ops. Engines pre-fuse at init
+    # (fuse_qkv_params) so the concat is a one-time cost; a raw param
+    # tree is fused here per forward (outside the scan — inside it,
+    # XLA re-materializes the concat every layer; measured on-chip in
+    # scripts/decompose_bert_forward.py). Attention at S <= 512 runs
+    # the dedicated grouped-heads encoder kernel
+    # (ops/encoder_attention.py) — the flash kernel's per-(b, h,
+    # block) grid overhead dominated at these shapes (the r3
+    # paged-kernel DMA-issue floor class; full forward 422 -> ~180 ms
+    # at arctic B=32 across the kernel iterations).
+    lw = params["layers"]
+    if "wqkv" in lw:
+        wqkv, bqkv = lw["wqkv"], lw["bqkv"]
+    else:
+        wqkv = jnp.concatenate([lw["wq"], lw["wk"], lw["wv"]], axis=-1)
+        bqkv = jnp.concatenate([lw["bq"], lw["bk"], lw["bv"]], axis=-1)
+
+    resolved_pallas = attn_ops.on_tpu() if use_pallas is None else use_pallas
+
     def body(x, w):
         h = attn_in = x
-        q = (h @ w["wq"] + w["bq"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
-        k = (h @ w["wk"] + w["bk"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
-        v = (h @ w["wv"] + w["bv"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
-        out = attn_ops.attention(q, k, v, causal=False, lengths=lengths,
-                                 use_pallas=use_pallas, interpret=interpret)
+        qkv = (h @ w["wqkv"] + w["bqkv"]).reshape(B, S, 3, H, Hd)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        if resolved_pallas and S <= 512:
+            # Dedicated encoder kernel: ONE grid step per batch row
+            # (heads looped inside) — the flash kernel's per-(b,h,
+            # block) grid overhead dominated at these shapes.
+            from generativeaiexamples_tpu.ops.encoder_attention import (
+                encoder_attention)
+
+            out = encoder_attention(q, k, v, lengths, interpret=interpret)
+        else:
+            out = attn_ops.attention(q, k, v, causal=False,
+                                     lengths=lengths,
+                                     use_pallas=use_pallas,
+                                     interpret=interpret,
+                                     block_q=min(S, 512),
+                                     block_k=min(S, 512))
         out = out.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
         x = layer_norm(attn_in + out @ w["wo"] + w["bo"],
                        w["ln1_w"], w["ln1_b"], cfg.ln_eps)
@@ -165,7 +215,10 @@ def forward(
                        w["ln2_w"], w["ln2_b"], cfg.ln_eps)
         return x, None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    xs = {"wqkv": wqkv, "bqkv": bqkv,
+          **{k_: v_ for k_, v_ in lw.items()
+             if k_ not in ("wq", "wk", "wv", "bq", "bk", "bv")}}
+    x, _ = jax.lax.scan(body, x, xs)
 
     mask = (jnp.arange(S)[None, :] < lengths[:, None]).astype(x.dtype)
     if cfg.pooling == "mean":

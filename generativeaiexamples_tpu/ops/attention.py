@@ -265,6 +265,7 @@ def on_tpu() -> bool:
 def attention(
     q, k, v, *, causal=True, lengths=None, q_offset=None, scale=None,
     use_pallas: Optional[bool] = None, mesh=None, interpret: bool = False,
+    block_q: int = 256, block_k: int = 256,
 ):
     """Dispatch: Pallas flash kernel on TPU, XLA reference elsewhere.
 
@@ -293,13 +294,15 @@ def attention(
             fn = shard_map(
                 lambda q_, k_, v_, ln_, off_: flash_attention(
                     q_, k_, v_, causal=causal, lengths=ln_, q_offset=off_,
-                    scale=scale, interpret=interpret),
+                    scale=scale, interpret=interpret,
+                    block_q=block_q, block_k=block_k),
                 mesh=mesh, in_specs=(hs, hs, hs, P(), P()), out_specs=hs,
                 check_rep=False)
             return fn(q, k, v, ln, off)
         return flash_attention(q, k, v, causal=causal, lengths=ln,
                                q_offset=off, scale=scale,
-                               interpret=interpret)
+                               interpret=interpret,
+                               block_q=block_q, block_k=block_k)
     return mha_reference(
         q, k, v, causal=causal, lengths=lengths, q_offset=q_offset, scale=scale
     )

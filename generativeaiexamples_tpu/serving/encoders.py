@@ -52,7 +52,10 @@ class EmbeddingEngine:
     def __init__(self, params, cfg: bert.BertConfig, tokenizer,
                  max_batch: int = 16, buckets: Sequence[int] = (32, 128, 512),
                  use_pallas: Optional[bool] = None):
-        self.params = params
+        # One-time QKV fusion: forward() projects with a [L, D, 3D]
+        # wqkv; fusing here keeps the concat out of every jitted call
+        # (~150 MB HBM transient per forward for BERT-large otherwise).
+        self.params = bert.fuse_qkv_params(params)
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_batch = max_batch
@@ -123,7 +126,7 @@ class RerankEngine:
                  max_batch: int = 8, buckets: Sequence[int] = (128, 256, 512),
                  use_pallas: Optional[bool] = None):
         assert cfg.n_labels >= 1, "reranker config must set n_labels"
-        self.params = params
+        self.params = bert.fuse_qkv_params(params)  # see EmbeddingEngine
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_batch = max_batch

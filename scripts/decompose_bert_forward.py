@@ -1,0 +1,154 @@
+"""On-chip attribution of the BERT encoder forward (VERDICT r4 #4).
+
+Times, at arctic-embed-l (B in {32, 64}) and reranker_base (B in
+{16, 32, 64}), S=512, bf16:
+  full        — bert.forward as shipped (flash or XLA attention,
+                whichever the dispatcher picks)
+  no_attn     — attention replaced by identity (attribution: matmul/
+                layernorm/gelu floor vs attention+layout cost)
+  fused_qkv   — q/k/v projected by ONE [D, 3D] matmul (fewer, larger
+                MXU ops), XLA attention
+All timings are min-of-5 with a full host readback (the tunnel's
+block_until_ready is unreliable — ENGINEERING_NOTES platform facts).
+
+Run (serialize with other chip users):
+  PYTHONPATH=/root/repo python scripts/decompose_bert_forward.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from generativeaiexamples_tpu.utils.platform import apply_platform_env  # noqa: E402
+
+apply_platform_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from generativeaiexamples_tpu.models import bert  # noqa: E402
+from generativeaiexamples_tpu.ops import attention as attn_ops  # noqa: E402
+
+
+def forward_variant(params, cfg, tokens, lengths, mode: str):
+    """bert.forward with a swappable attention/projection block."""
+    B, S = tokens.shape
+    H, Hd = cfg.n_heads, cfg.head_dim
+    x = (params["tok_emb"][tokens]
+         + params["pos_emb"][jnp.arange(S)][None]
+         + params["type_emb"][jnp.zeros_like(tokens)])
+    x = bert.layer_norm(x, params["emb_ln"]["w"], params["emb_ln"]["b"],
+                        cfg.ln_eps)
+
+    fused = mode in ("fused_qkv", "flash512_fused")
+    lw = params["layers"]
+    if fused:
+        # Hoisted OUTSIDE the scan like the shipped forward — an
+        # in-scan concat re-materializes per layer and measures a
+        # strictly worse variant than production.
+        lw = dict(lw)
+        lw["wqkv"] = jnp.concatenate([lw["wq"], lw["wk"], lw["wv"]], -1)
+        lw["bqkv"] = jnp.concatenate([lw["bq"], lw["bk"], lw["bv"]], -1)
+
+    def body(x, w):
+        attn_in = x
+        if fused:
+            qkv = x @ w["wqkv"] + w["bqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = x @ w["wq"] + w["bq"]
+            k = x @ w["wk"] + w["bk"]
+            v = x @ w["wv"] + w["bv"]
+        q = q.reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+        if mode == "no_attn":
+            out = v
+        else:
+            lengths_ = jnp.full((B,), S, jnp.int32) if lengths is None \
+                else lengths
+            if mode in ("flash512", "flash512_fused"):
+                # Full-sequence blocks: grid (B, H, 1, 1) — probes
+                # whether the flash kernel's D=64 cost is grid-step
+                # overhead (r3's paged-kernel DMA-issue floor class).
+                out = attn_ops.flash_attention(
+                    q, k, v, causal=False, lengths=lengths_,
+                    block_q=S, block_k=S)
+            else:
+                use_pallas = None if mode == "full" else False
+                out = attn_ops.attention(q, k, v, causal=False,
+                                         lengths=lengths_,
+                                         use_pallas=use_pallas)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, H * Hd)
+        x = bert.layer_norm(attn_in + out @ w["wo"] + w["bo"],
+                            w["ln1_w"], w["ln1_b"], cfg.ln_eps)
+        h = jax.nn.gelu(x @ w["w_in"] + w["b_in"], approximate=False)
+        x = bert.layer_norm(x + h @ w["w_out"] + w["b_out"],
+                            w["ln2_w"], w["ln2_b"], cfg.ln_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, lw)
+    return x[:, 0]
+
+
+def timed(fn, *args, reps=5):
+    np.asarray(fn(*args))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def flops(cfg, B, S):
+    per_tok_layer = 2 * (4 * cfg.dim ** 2 + 2 * cfg.dim * cfg.mlp_dim)
+    attn = 2 * 2 * cfg.n_heads * S * S * cfg.head_dim  # qk + pv per seq
+    return B * (S * per_tok_layer + attn) * cfg.n_layers
+
+
+def main() -> int:
+    print(f"backend={jax.default_backend()}")
+    rng = np.random.default_rng(0)
+    S = 512
+    for name, cfg_fn, batches in (
+            ("arctic-embed-l", bert.BertConfig.arctic_embed_l, (32, 64)),
+            ("reranker_base", bert.BertConfig.reranker_base, (16, 32, 64))):
+        cfg = dataclasses.replace(cfg_fn(), dtype=jnp.bfloat16)
+        params = bert.init_params(cfg, jax.random.PRNGKey(0))
+        for B in batches:
+            tokens = jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+            lengths = jnp.asarray(rng.integers(200, S + 1, (B,)), jnp.int32)
+            row = {}
+            for mode in ("full", "no_attn", "fused_qkv", "flash512",
+                         "flash512_fused"):
+                fn = jax.jit(lambda p, t, l, m=mode: forward_variant(
+                    p, cfg, t, l, m))
+                try:
+                    row[mode] = timed(fn, params, tokens, lengths)
+                except Exception as e:
+                    row[mode] = None
+                    print(f"{name} B={B} {mode}: FAILED "
+                          f"{type(e).__name__}: {str(e)[:200]}")
+            tf = flops(cfg, B, S)
+            parts = []
+            for mode, t in row.items():
+                if t is None:
+                    continue
+                mxu = tf / t / 197e12 * 100  # v5e bf16 peak ~197 TFLOP/s
+                parts.append(f"{mode} {t*1e3:.1f}ms ({B/t:.0f}/s, "
+                             f"{mxu:.0f}% MXU)")
+            print(f"{name} B={B}: " + "  ".join(parts))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
