@@ -33,9 +33,18 @@ def tp_cfg(n_kv_heads=8):
                              mlp_dim=128, max_seq_len=256, dtype=jnp.float32)
 
 
+# pace_emission_max_streams=0: these tests assert EXACT token equality
+# between the mesh and single-device engines on random weights, where
+# f32 logit gaps sit near argmax ties. The emission pacer's thread
+# perturbs the EMULATED CPU mesh's collective reduction order via GIL
+# scheduling (real ICI all-reduces are deterministic), flipping those
+# ties ~30-50% of runs — measured by bisection, r5. Pacing is
+# irrelevant to what these tests verify and has its own suite
+# (tests/test_serving.py::TestEmissionPacing).
 ECFG = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=32,
                     prefill_buckets=(32, 64), decode_steps_per_dispatch=4,
-                    pipeline_depth=2, compile_cache_dir="")
+                    pipeline_depth=2, compile_cache_dir="",
+                    pace_emission_max_streams=0)
 
 
 def run_engine(params, cfg, mesh=None, prompts=None, **gen_kw):
