@@ -138,12 +138,31 @@ def main() -> None:
                         decode_steps_per_dispatch=k_steps,
                         pipeline_depth=depth,
                         speculative_k=spec_k)
-    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg)
     # Precompile EVERY (bucket, group-size) prefill variant and the
     # decode K-buckets — mid-traffic compiles would otherwise stall the
-    # staggered-arrival measurement by tens of seconds.
-    t0 = time.perf_counter()
-    eng.warmup()
+    # staggered-arrival measurement by tens of seconds. One retry: the
+    # axon tunnel's remote-compile server intermittently drops a
+    # response or 500s (three distinct flakes observed in one r5
+    # session — ENGINEERING_NOTES); a transient must not zero out the
+    # round's benchmark artifact.
+    eng = None
+    for attempt in (1, 2):
+        t0 = time.perf_counter()  # per attempt: a retried run's warmup
+        try:                      # figure must not include the failure
+            eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg)
+            eng.warmup()
+            break
+        except Exception as e:
+            if attempt == 2:
+                raise
+            print(f"[bench] engine build/warmup failed "
+                  f"({type(e).__name__}: {str(e)[:160]}); retrying once",
+                  file=sys.stderr)
+            eng = None
+            import gc
+
+            gc.collect()
+            time.sleep(10)
     eng.start()
     prompt = list(range(2, 2 + prompt_len))
     list(eng.generate_stream(prompt, max_new_tokens=4))  # e2e smoke
