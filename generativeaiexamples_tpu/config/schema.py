@@ -231,6 +231,17 @@ class EngineConfig:
     # at a fine grain (8k-under-load TTFT ~2 s instead of 3.4 s) while
     # the pacer keeps live-stream cadence smooth. 0 = no cap.
     prefill_decode_k_cap: int = 2
+    # Cross-request prefix KV reuse (the RadixAttention / vLLM-APC /
+    # NIM KV-reuse role, serving/prefix_cache.py): a host-side radix
+    # tree maps page-granular prompt prefixes to ref-counted pool
+    # pages; admissions adopt the longest cached prefix and prefill
+    # ONLY the uncached suffix. Off by default — cache-off behavior is
+    # identical to the pre-cache engine.
+    prefix_cache: bool = False
+    # Fraction of the page pool the radix tree may hold as cached
+    # pages (LRU-trimmed beyond this; allocator pressure evicts
+    # further — live sequences always win over the cache).
+    prefix_cache_capacity: float = 0.5
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
 

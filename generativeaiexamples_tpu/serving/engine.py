@@ -174,9 +174,9 @@ class _LongPrefill:
     decode traffic, chunks run at full dispatch speed."""
 
     __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot",
-                 "beat")
+                 "beat", "chunk")
 
-    def __init__(self, req, slot_idx, seq, ids, cache, slot):
+    def __init__(self, req, slot_idx, seq, ids, cache, slot, chunk):
         self.req = req
         self.slot_idx = slot_idx
         self.seq = seq
@@ -185,6 +185,10 @@ class _LongPrefill:
         self.pos = 0  # next prompt offset to feed
         self.slot = slot  # the placeholder occupying slots[slot_idx]
         self.beat = -1  # reader beat at which the last chunk dispatched
+        # Chunk width per forward: the largest bucket for long prompts;
+        # prefix-cache hits on short prompts use the suffix's bucket so
+        # a small uncached tail never pays a full-width forward.
+        self.chunk = chunk
 
 
 class EngineMetrics:
@@ -203,6 +207,17 @@ class EngineMetrics:
         # acceptance-rate gauge (1.0 = no drafts accepted, k+1 = all).
         self.spec_committed = 0
         self.spec_slot_steps = 0
+        # Prompt tokens actually run through a prefill forward (valid
+        # tokens, not bucket padding) — with the prefix cache on, a hit
+        # adds only its uncached suffix here.
+        self.prefill_tokens = 0
+        # Prefix-cache counters (serving/prefix_cache.py): lookups that
+        # adopted cached pages / that found nothing, pages LRU-evicted,
+        # and prompt tokens whose prefill was skipped via the cache.
+        self.prefix_hits = 0
+        self.prefix_miss = 0
+        self.prefix_evictions = 0
+        self.prefix_hit_tokens = 0
         self.started = time.perf_counter()
         # (timestamp, n_tokens) per decode dispatch for the sliding rate.
         self._token_events: deque = deque(maxlen=8192)
@@ -261,6 +276,11 @@ class EngineMetrics:
             "decode_steps": self.decode_steps,
             "mean_batch_occupancy": occ,
             "tokens_per_sec": self.tokens_per_sec(),
+            "prefill_tokens": self.prefill_tokens,
+            "prefix_hits": self.prefix_hits,
+            "prefix_miss": self.prefix_miss,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
         }
         if self.spec_slot_steps:
             out["spec_tokens_per_step"] = (self.spec_committed
@@ -355,6 +375,18 @@ class LLMEngine:
                                    sharding=kv_sharding,
                                    scale_sharding=scale_sharding)
         self.allocator = PageAllocator(n_pages)
+        # Cross-request prefix KV reuse (serving/prefix_cache.py):
+        # scheduler-thread-owned, like the allocator. The allocator's
+        # reclaim hook LRU-evicts cached pages whenever live traffic
+        # runs short, so the cache can never starve a sequence.
+        self.prefix_cache = None
+        if self.ecfg.prefix_cache:
+            from generativeaiexamples_tpu.serving.prefix_cache import (
+                RadixPrefixCache)
+
+            cap = int(max(0.0, self.ecfg.prefix_cache_capacity) * n_pages)
+            self.prefix_cache = RadixPrefixCache(self.allocator, ps, cap)
+            self.allocator.reclaim = self._reclaim_cached_pages
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()
         self.metrics = EngineMetrics()
@@ -513,6 +545,12 @@ class LLMEngine:
                         self._put(np.zeros((n,), np.int32)),
                         key, self.use_pallas, sampling_flags=flags,
                         mesh=self.mesh)
+                    # The admission scatter compiles per group size;
+                    # out-of-bounds indices drop, so this writes nothing.
+                    self._last_tokens = engine_model.set_last_tokens(
+                        self._last_tokens,
+                        self._put(np.full((n,), len(self.slots), np.int32)),
+                        toks)
         B = self.ecfg.max_batch_size
         if self._spec_k:
             # Spec engines dispatch ONLY verify blocks; warm those
@@ -576,6 +614,14 @@ class LLMEngine:
                 s_tots = list(range(chunk, self.max_pages * ps + 1, chunk))
             logits = None
             for s_tot in s_tots:
+                if self.prefix_cache is not None:
+                    # Long-prompt prefix HITS seed their scratch from
+                    # the pool at these same shapes; compile the gather
+                    # now (result discarded — pool is not donated).
+                    engine_model.pool_to_cache(
+                        self.pool, self.cfg,
+                        self._put(np.zeros((s_tot // ps,), np.int32)),
+                        self._put(np.int32(1)))
                 cache = KVCache.zeros(self.cfg, 1, max_len=s_tot)
                 cache = self._place_scratch_cache(cache)
                 logits, cache = engine_model.prefill_chunk_step(
@@ -600,6 +646,52 @@ class LLMEngine:
                         logits, 0.0, 1.0, 0, key, *flags)
                 self._last_tokens = engine_model.set_last_token(
                     self._last_tokens, self._put(np.int32(0)), tok0)
+        if self.prefix_cache is not None:
+            # Prefix-cache hit variants for SHORT prompts: a hit
+            # gathers into a bucket-sized scratch (pool_to_cache per
+            # S_total), feeds the suffix at its own bucket width
+            # (prefill_chunk_step per (S_total, chunk) pair), then
+            # finishes through cache_to_pool and the chunked-prefill
+            # sampler. Cold, any of these compiles on the scheduler
+            # thread at the FIRST live hit — the stall warmup exists
+            # to prevent.
+            bset = sorted(buckets or self.buckets)
+            logits = None
+            for s_tot in bset:
+                cache = engine_model.pool_to_cache(
+                    self.pool, self.cfg,
+                    self._put(np.zeros((s_tot // ps,), np.int32)),
+                    self._put(np.int32(1)))
+                # Same gather -> place -> chunk chain as the live hit
+                # path (jit specializes on input sharding).
+                cache = self._place_scratch_cache(cache)
+                for chunk in [b for b in bset if b <= s_tot]:
+                    logits, cache = engine_model.prefill_chunk_step(
+                        self.params, self.cfg, cache,
+                        self._put(np.zeros((1, chunk), np.int32)),
+                        self._put(np.int32(1)), self.use_pallas,
+                        mesh=self.mesh)
+                self.pool = engine_model.cache_to_pool(
+                    self.pool, cache, self.cfg,
+                    self._put(np.zeros((s_tot // ps,), np.int32)))
+            tok0 = None
+            for flags in flag_sets:
+                tok0 = engine_model.sample_token(logits, 0.0, 1.0, 0,
+                                                 key, *flags)
+            self._last_tokens = engine_model.set_last_token(
+                self._last_tokens, self._put(np.int32(0)), tok0)
+            if self._spec_k:
+                # Hit finishes write history through the full-width
+                # single-row variant (long_prompts warmup only covers
+                # it when that flag is on).
+                self._history, self._dev_lengths = \
+                    engine_model.set_history_rows(
+                        self._history, self._dev_lengths,
+                        self._put(np.full((1,), B, np.int32)),
+                        self._put(np.zeros((1, self.ecfg.max_seq_len),
+                                           np.int32)),
+                        self._put(np.ones((1,), np.int32)),
+                        self._put(np.zeros((1,), np.int32)))
         jax.block_until_ready(self._last_tokens)
         _LOG.info("engine warmup: %d prefill + %d decode variants compiled",
                   len(self.buckets if buckets is None else buckets)
@@ -895,30 +987,64 @@ class LLMEngine:
                     break
                 req = self.waiting.popleft()
             ids = req.prompt_ids or [0]
-            if (len(ids) > self.buckets[-1]
-                    and len(self._long_prefills) >= self._max_long_prefills):
+            long = len(ids) > self.buckets[-1]
+            lane_full = len(self._long_prefills) >= self._max_long_prefills
+            if long and lane_full:
                 # Bound concurrent scratch caches: each long prefill
-                # holds a full-length device KVCache; admitting a burst
-                # of them at once would multiply the old (synchronous)
-                # path's peak device memory. Defer — short prompts keep
-                # flowing.
+                # (and each prefix-cache hit — same machinery) holds a
+                # device KVCache; admitting a burst of them at once
+                # would multiply the old (synchronous) path's peak
+                # device memory. Deferred BEFORE the radix lookup: a
+                # backlogged long prompt must not pay an O(prompt)
+                # match (and skew the LRU) on every admission pass.
                 deferred_long.append(req)
                 continue
+            hit = self._lookup_prefix(ids) \
+                if self.prefix_cache is not None else None
+            demoted = False
+            if hit is not None and lane_full:
+                # Short prompt, scratch lane busy: fall back to the
+                # plain batched prefill rather than queueing behind
+                # the lane.
+                self._release_hit_pin(hit)
+                hit, demoted = None, True
             seq = SequencePages(self.allocator, self.pool.page_size,
                                 self.max_pages)
             try:
+                if hit is not None:
+                    seq.adopt(hit[0], hit[1])
                 seq.ensure(len(ids))
             except MemoryError as e:
                 seq.release()
+                self._release_hit_pin(hit)
                 _LOG.warning("admission failed (%s); requeueing", e)
                 with self._lock:
                     self.waiting.appendleft(req)
                 break
+            if self.prefix_cache is not None:
+                if hit is None:
+                    # A demotion (cached prefix, busy scratch lane) is
+                    # NOT a miss — miscounting it would show the hit
+                    # rate collapsing exactly when the cache is hot
+                    # and the engine is busy.
+                    if not demoted:
+                        self.metrics.prefix_miss += 1
+                else:
+                    self.metrics.prefix_hits += 1
+                    self.metrics.prefix_hit_tokens += hit[1]
             # Reserve the slot now so the next iteration sees it taken;
             # the real _Slot replaces the placeholder at dispatch.
             placeholder = _Slot(req, seq, None)
             self.slots[slot_idx] = placeholder
-            if len(ids) > self.buckets[-1]:
+            if hit is not None:
+                try:
+                    self._begin_prefix_prefill(req, slot_idx, seq, ids,
+                                               hit[0], hit[1], placeholder)
+                except Exception:
+                    _LOG.exception("prefix-hit prefill setup failed")
+                    self._fail_request(req, slot_idx, seq)
+                continue
+            if long:
                 try:
                     self._begin_long_prefill(req, slot_idx, seq, ids,
                                              placeholder)
@@ -1024,6 +1150,12 @@ class LLMEngine:
                          span=span)
             self.slots[slot_idx] = slot
             metas.append((slot_idx, slot))
+            self.metrics.prefill_tokens += len(ids)
+            # Completed prefill: its full prompt pages become reusable
+            # by later identical/shared-prefix prompts (the page writes
+            # are already dispatched; device ordering sequences any
+            # later gather after them).
+            self._insert_prefix(ids, seq)
         # Start the (tiny, [N] int32) first-token transfer NOW: it rides
         # the tunnel concurrently with in-flight block readbacks, so the
         # first token reaches the stream ~one prefill + one RTT after
@@ -1060,7 +1192,102 @@ class LLMEngine:
             KVCache.zeros(self.cfg, 1, max_len=S_total))
         placeholder.prefilling = True
         self._long_prefills.append(
-            _LongPrefill(req, slot_idx, seq, ids, cache, placeholder))
+            _LongPrefill(req, slot_idx, seq, ids, cache, placeholder, chunk))
+
+    # -- prefix cache ------------------------------------------------------
+
+    def _reclaim_cached_pages(self, n: int) -> None:
+        """Allocator shortfall hook: LRU-evict cold cached prefixes so
+        live traffic always wins over the cache."""
+        freed = self.prefix_cache.evict(n)
+        if freed:
+            self.metrics.prefix_evictions += freed
+
+    def _lookup_prefix(self, ids: List[int]):
+        """Longest cached page-granular prefix of this prompt, capped
+        at len(ids) - 1 so at least one suffix token always runs
+        through the model (its logits sample the first output token).
+        Returns (pages, n_tokens) or None; when the cap lands mid-page
+        the last page is gather-only (SequencePages.adopt turns it into
+        a copy-on-write private tail) and is PINNED here — the adopt/
+        ensure allocations between lookup and the gather can trigger
+        reclaim eviction of refcount-1 tree pages, and the sequence
+        holds no reference of its own to this one. Every consumer of a
+        hit must release the pin (_release_hit_pin)."""
+        pages = self.prefix_cache.match(ids)
+        if not pages:
+            return None
+        ps = self.pool.page_size
+        m = min(len(pages) * ps, len(ids) - 1)
+        if m <= 0:
+            return None
+        pages = pages[: -(-m // ps)]
+        if m % ps:
+            self.allocator.retain([pages[-1]])
+        return pages, m
+
+    def _release_hit_pin(self, hit) -> None:
+        """Drop _lookup_prefix's pin on the gather-only tail page (a
+        no-op for page-aligned matches)."""
+        if hit is not None and hit[1] % self.pool.page_size:
+            self.allocator.release([hit[0][-1]])
+
+    def _insert_prefix(self, ids: List[int], seq: SequencePages) -> None:
+        """Register a completed prefill's FULL prompt pages in the
+        radix tree (partial tail pages stay private — decode writes
+        into them). The tree retains its own references; on chunk
+        collisions the existing page wins and the duplicate stays with
+        the sequence."""
+        if self.prefix_cache is None:
+            return
+        n_full = len(ids) // self.pool.page_size
+        if n_full <= 0:
+            return
+        self.prefix_cache.insert(list(ids), seq.pages[:n_full])
+        freed = self.prefix_cache.trim()
+        if freed:
+            self.metrics.prefix_evictions += freed
+
+    def _begin_prefix_prefill(self, req: GenRequest, slot_idx: int,
+                              seq: SequencePages, ids: List[int],
+                              pages: List[int], m: int,
+                              placeholder: "_Slot") -> None:
+        """Admission for a prefix-cache hit: seed a scratch KVCache with
+        the matched pages' KV (one gather — the exact bytes decode
+        attention reads for those pages) and run ONLY the uncached
+        suffix ids[m:] through the chunked-prefill lane, its queries
+        offset by m. The finish scatter points the adopted read-only
+        rows at the page-0 sink, so shared pages are never rewritten;
+        a CoW tail page is rewritten whole (gathered head + computed
+        tail) from the scratch cache. Owns _lookup_prefix's pin on the
+        gather-only tail page: released once the gather is dispatched
+        (or on any failure)."""
+        try:
+            ps = self.pool.page_size
+            plen = len(ids)
+            if plen <= self.buckets[-1]:
+                chunk = self._bucket_for(plen - m)
+                s_total = self._bucket_for(plen)
+            else:
+                chunk = self.buckets[-1]
+                s_total = -(-plen // chunk) * chunk
+            row = np.zeros((s_total // ps,), np.int32)
+            row[: len(pages)] = pages
+            cache = engine_model.pool_to_cache(
+                self.pool, self.cfg, self._put(row),
+                self._put(np.int32(m)))
+            # Same placement as warmup's scratch caches — jit
+            # specializes on input sharding, so a differently-placed
+            # live cache would recompile prefill_chunk_step on the
+            # scheduler thread (no-op off-mesh and when GSPMD already
+            # chose the warmed placement).
+            cache = self._place_scratch_cache(cache)
+        finally:
+            self._release_hit_pin((pages, m))
+        placeholder.prefilling = True
+        lp = _LongPrefill(req, slot_idx, seq, ids, cache, placeholder, chunk)
+        lp.pos = m
+        self._long_prefills.append(lp)
 
     def _advance_long_prefills(self) -> bool:
         """Dispatch at most ONE chunk for each in-progress long prefill
@@ -1086,7 +1313,7 @@ class LLMEngine:
                 # via the loop's block-per-iteration shape.
                 continue
             lp.beat = self._beat
-            chunk = self.buckets[-1]
+            chunk = lp.chunk
             n_chunks = max(1, self.ecfg.prefill_chunks_per_block) \
                 if decoding else 1
             try:
@@ -1101,6 +1328,7 @@ class LLMEngine:
                         self._put(np.int32(len(part))), self.use_pallas,
                         mesh=self.mesh)
                     lp.pos += len(part)
+                    self.metrics.prefill_tokens += len(part)
                     if lp.pos >= len(lp.ids):
                         self._long_prefills.remove(lp)
                         self._finish_long_prefill(lp, logits)
@@ -1121,8 +1349,15 @@ class LLMEngine:
         S_total = lp.cache.k.shape[-2]
         row = np.zeros((S_total // ps,), np.int32)  # padding -> sink 0
         row[:len(lp.seq.pages)] = lp.seq.pages
+        # Pages adopted read-only from the prefix cache must never be
+        # rewritten: their rows scatter into the page-0 sink. (A CoW
+        # tail page is NOT shared — it is rewritten whole from the
+        # scratch cache: gathered head + computed tail.)
+        if lp.seq.n_shared:
+            row[:lp.seq.n_shared] = 0
         self.pool = engine_model.cache_to_pool(self.pool, lp.cache, self.cfg,
                                                self._put(row))
+        self._insert_prefix(lp.ids, lp.seq)
         req = lp.req
         greedy = req.temperature <= 0.0
         flags = (True, False, False) if greedy else (False, True, True)
@@ -1490,6 +1725,13 @@ class LLMEngine:
             used = (slot.kv_len + slot.kv_worst) if self._spec_k \
                 else slot.seq.length
             table_cap, avail = self._advance_capacity(slot, used)
+            if self.prefix_cache is not None and avail < r:
+                # Cold cached pages are reclaimable on demand (the
+                # allocator's reclaim hook evicts inside alloc); a slot
+                # must not be cut with 'length' while they could back
+                # it. Slow path only — reclaimable() walks the tree.
+                avail += self.prefix_cache.reclaimable() * \
+                    self.pool.page_size
             if table_cap >= r and avail >= r:
                 slot.no_capacity = False
                 continue

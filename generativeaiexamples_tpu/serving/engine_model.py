@@ -694,6 +694,43 @@ def prefill_chunk_step(
     return last[0, 0], cache
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def pool_to_cache(
+    pool: PagePool, cfg: LlamaConfig,
+    table_row: jax.Array,  # [S_cache // page_size] page ids (0-padded)
+    n_tokens: jax.Array,   # [] valid prefix tokens
+):
+    """Gather cached prefix pages into a fresh contiguous scratch cache
+    (batch 1, max_len = len(table_row) * page_size, model dtype) — the
+    inverse of cache_to_pool, used by prefix-cache hits: the uncached
+    suffix then runs through prefill_chunk_step with its queries offset
+    by cache.lengths = n_tokens. The cache is built INSIDE the jit from
+    the gather itself (rows past the prefix read sink page 0), so no
+    zero-filled scratch is ever materialized on the hit path. int8
+    pools dequantize with their narrow per-token scales — exactly the
+    values decode attention reads for those pages."""
+    from generativeaiexamples_tpu.models.llama import KVCache
+
+    ps = pool.page_size
+    S = table_row.shape[0] * ps
+    L, KH, Hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    dt = jnp.dtype(cfg.dtype)
+    li, kh, tb = _page_axes(L, KH, table_row)
+    if pool.quantized:
+        k = (pool.kv[0, li, kh, tb].astype(dt)
+             * pool.s[0, li, kh, tb][..., None].astype(dt))
+        v = (pool.kv[1, li, kh, tb].astype(dt)
+             * pool.s[1, li, kh, tb][..., None].astype(dt))
+    else:
+        k = pool.k[li, kh, tb].astype(dt)
+        v = pool.v[li, kh, tb].astype(dt)
+    # [L, KH, npages, ps, Hd] -> the cache's [L, B=1, KH, S, Hd]
+    k = k.reshape(L, KH, S, Hd)[:, None]
+    v = v.reshape(L, KH, S, Hd)[:, None]
+    lengths = jnp.full((1,), n_tokens, jnp.int32)
+    return KVCache(k, v, lengths)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("pool",))
 def cache_to_pool(

@@ -135,27 +135,67 @@ jax.tree_util.register_dataclass(
 
 
 class PageAllocator:
-    """Host-side free list. Page 0 is never handed out (garbage sink)."""
+    """Host-side REF-COUNTED free list. Page 0 is never handed out
+    (garbage sink).
+
+    Pages are born with refcount 1 at alloc(); retain() adds a
+    reference (prefix-cache sharing: the radix tree and every adopting
+    sequence each hold one), release() drops one and returns the page
+    to the free list at zero. free() is the historical name for
+    release() and now RAISES on a double free or on a page id that was
+    never allocated — a silent double free used to put the same id on
+    the free list twice, handing one page to two sequences.
+
+    `reclaim` (optional callable, n_short -> None) runs when alloc()
+    comes up short, before failing: the prefix cache registers its LRU
+    eviction here so cold cached pages always yield to live traffic.
+    """
 
     def __init__(self, n_pages: int):
         self.n_pages = n_pages
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._rc: dict = {}  # page id -> refcount (allocated pages only)
+        self.reclaim = None
 
     @property
     def n_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._rc.get(page, 0)
+
     def alloc(self, n: int) -> List[int]:
+        if n > len(self._free) and self.reclaim is not None:
+            self.reclaim(n - len(self._free))
         if n > len(self._free):
             raise MemoryError(f"KV page pool exhausted: want {n}, have "
                               f"{len(self._free)} of {self.n_pages}")
         out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._rc[p] = 1
         return out
 
-    def free(self, pages: Sequence[int]) -> None:
+    def retain(self, pages: Sequence[int]) -> None:
         for p in pages:
-            assert 0 < p < self.n_pages, p
-            self._free.append(p)
+            if p not in self._rc:
+                raise ValueError(f"retain of unallocated page {p}")
+            self._rc[p] += 1
+
+    def release(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.n_pages:
+                raise ValueError(f"page id {p} out of range "
+                                 f"(pool has {self.n_pages})")
+            rc = self._rc.get(p, 0)
+            if rc <= 0:
+                raise ValueError(f"double free of page {p}")
+            if rc == 1:
+                del self._rc[p]
+                self._free.append(p)
+            else:
+                self._rc[p] = rc - 1
+
+    free = release  # historical name; raising beats silent corruption
 
 
 class SequencePages:
@@ -167,6 +207,36 @@ class SequencePages:
         self.max_pages = max_pages
         self.pages: List[int] = []
         self.length = 0  # tokens written
+        # Leading pages adopted READ-ONLY from the prefix cache: this
+        # sequence holds a reference but must never write them (the
+        # engine points their scatter rows at the page-0 sink).
+        self.n_shared = 0
+
+    def adopt(self, pages: Sequence[int], n_tokens: int):
+        """Adopt a cached prefix: `pages` (ref-counted, read-only)
+        cover `n_tokens` (<= len(pages) * page_size). Fully-covered
+        pages are shared in place; a partially-covered tail page is
+        COPY-ON-WRITE — a fresh private page takes its table slot and
+        the caller must fill its contents (the engine's scratch-cache
+        scatter rewrites the whole page: cached head + computed tail).
+        Returns the (src_page, dst_page) CoW pair, or None when the
+        prefix ends exactly on a page boundary."""
+        assert not self.pages and self.length == 0, "adopt() before ensure()"
+        ps = self.page_size
+        if not 0 < n_tokens <= len(pages) * ps:
+            raise ValueError(f"adopt: {n_tokens} tokens not covered by "
+                             f"{len(pages)} pages of {ps}")
+        n_full = n_tokens // ps
+        self.allocator.retain(pages[:n_full])
+        self.pages = list(pages[:n_full])
+        self.n_shared = n_full
+        cow = None
+        if n_tokens % ps:
+            dst = self.allocator.alloc(1)[0]
+            self.pages.append(dst)
+            cow = (pages[n_full], dst)
+        self.length = n_tokens
+        return cow
 
     def ensure(self, new_length: int) -> None:
         """Grow the page list to cover new_length tokens."""
@@ -184,7 +254,11 @@ class SequencePages:
         return row
 
     def release(self) -> None:
-        if self.pages:
-            self.allocator.free(self.pages)
-            self.pages = []
+        """Idempotent: the page list is nulled out BEFORE the allocator
+        call, so engine error paths that release twice (_fail_request
+        racing _fail_active) are no-ops instead of double frees."""
+        pages, self.pages = self.pages, []
         self.length = 0
+        self.n_shared = 0
+        if pages:
+            self.allocator.release(pages)

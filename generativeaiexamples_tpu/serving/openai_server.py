@@ -156,12 +156,22 @@ class OpenAIServer:
         except Exception as e:  # device lost (e.g. TPU preemption)
             return web.json_response({"status": "unhealthy", "error": str(e)},
                                      status=503)
-        return web.json_response({
+        payload = {
             "status": "healthy", "devices": n,
             "engines": {"llm": self.llm is not None,
                         "embedding": self.embed is not None,
                         "reranking": self.rerank is not None},
-        })
+        }
+        pc = getattr(self.llm, "prefix_cache", None)
+        if pc is not None:
+            m = self.llm.metrics
+            payload["prefix_cache"] = {
+                "enabled": True, "cached_pages": pc.n_cached_pages,
+                "hits": m.prefix_hits, "misses": m.prefix_miss,
+                "evictions": m.prefix_evictions,
+                "hit_tokens": m.prefix_hit_tokens,
+            }
+        return web.json_response(payload)
 
     async def handle_models(self, request: web.Request) -> web.Response:
         models = []

@@ -1,0 +1,184 @@
+"""Radix-tree prefix KV cache: cross-request KV reuse at page granularity.
+
+RAG traffic is dominated by shared prefixes — every pipeline prepends
+the same system prompt, multi-turn chats replay the conversation so
+far, and popular queries retrieve the same context chunks — yet the
+engine used to re-prefill every request from token zero. This is the
+TPU-native analogue of SGLang's RadixAttention / vLLM's automatic
+prefix caching (and the NIM/TRT-LLM KV-reuse feature, SURVEY.md §2.3):
+a HOST-side radix tree keyed on page-size token-id chunks maps prompt
+prefixes to ref-counted pages in the existing device PagePool.
+
+Design:
+
+- One tree node per FULL page: the edge key is the tuple of page_size
+  token ids, the node owns one pool page id holding those tokens' KV
+  (every layer — pages are [L, KH, page, ps, Hd] slices of the pool).
+  Partial tail pages are never cached: only whole pages whose content
+  is fully determined by the prompt prefix are shareable.
+- Reference counting lives in the PageAllocator: the tree holds one
+  reference per cached page, every adopting sequence holds another
+  (SequencePages.adopt). A page returns to the free list only when the
+  tree has evicted it AND no sequence reads it.
+- The tree is owned by the single scheduler thread (same discipline as
+  the allocator); no locking.
+- Eviction is LRU over leaves whose page only the tree references
+  (refcount == 1): evicting a leaf exposes its parent, so a cold chain
+  unwinds back-to-front. Triggered two ways: `trim()` keeps the tree
+  under its capacity budget after inserts, and the allocator's
+  `reclaim` hook calls `evict()` when live traffic runs short of free
+  pages — the cache always yields to live sequences.
+
+The engine's admission path calls `match()` for the longest cached
+prefix, adopts those pages into the new sequence, seeds a scratch cache
+from them (engine_model.pool_to_cache) and prefills only the uncached
+suffix; completed prefills call `insert()` so their full prompt pages
+become reusable. See docs/prefix_cache.md.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Sequence
+
+from generativeaiexamples_tpu.serving.kv_cache import PageAllocator
+
+
+class _Node:
+    __slots__ = ("key", "page", "children", "parent", "last_used")
+
+    def __init__(self, key, page: int, parent):
+        self.key = key          # tuple of page_size token ids (root: None)
+        self.page = page        # pool page id (root: 0, the sink)
+        self.parent = parent
+        self.children: dict = {}
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Page-granular radix tree over prompt token ids -> pool pages."""
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 capacity_pages: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        # Budget for pages the tree holds (referenced or not); trim()
+        # LRU-evicts down to it after inserts. Allocator pressure can
+        # shrink the resident set further at any time.
+        self.capacity_pages = max(0, int(capacity_pages))
+        self.root = _Node(None, 0, None)
+        self._clock = 0   # monotonic LRU clock (no wall time needed)
+        self._n_pages = 0
+        self.evictions = 0  # total pages evicted (engine mirrors this)
+
+    @property
+    def n_cached_pages(self) -> int:
+        return self._n_pages
+
+    # -- internals ---------------------------------------------------------
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_used = self._clock
+
+    def _chunks(self, ids: Sequence[int]):
+        ps = self.page_size
+        for i in range(0, len(ids) - ps + 1, ps):
+            yield tuple(ids[i:i + ps])
+
+    def _leaves(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                yield n
+
+    # -- public API (scheduler thread only) --------------------------------
+
+    def match(self, ids: Sequence[int]) -> List[int]:
+        """Longest cached page-granular prefix of `ids` -> page list
+        (pages[i] holds tokens ids[i*ps:(i+1)*ps]). Touches the whole
+        matched path so hot prefixes stay resident."""
+        node, pages = self.root, []
+        for chunk in self._chunks(ids):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            self._touch(child)
+            pages.append(child.page)
+            node = child
+        return pages
+
+    def insert(self, ids: Sequence[int], pages: Sequence[int]) -> int:
+        """Register a completed prefill: chunk i of `ids` maps to
+        pages[i] (the sequence's pages; the tree retains its OWN
+        reference on adoption). Chunks already present keep their
+        existing page — dedup: the duplicate stays private to the
+        inserting sequence and is freed at its release. Returns the
+        number of pages newly adopted."""
+        node, new = self.root, 0
+        for i, chunk in enumerate(self._chunks(ids)):
+            if i >= len(pages):
+                break
+            child = node.children.get(chunk)
+            if child is None:
+                self.allocator.retain([pages[i]])
+                child = _Node(chunk, pages[i], node)
+                node.children[chunk] = child
+                self._n_pages += 1
+                new += 1
+            self._touch(child)
+            node = child
+        return new
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to n_pages LRU leaf pages that only the tree
+        references, releasing them back to the allocator. Returns the
+        count actually freed (live-referenced chains are skipped)."""
+        freed = 0
+        heap = [(n.last_used, id(n), n) for n in self._leaves()]
+        heapq.heapify(heap)
+        while heap and freed < n_pages:
+            _, _, node = heapq.heappop(heap)
+            if node.children:
+                continue  # gained a child since collection; not a leaf
+            if self.allocator.refcount(node.page) != 1:
+                continue  # a live sequence still reads it
+            del node.parent.children[node.key]
+            self.allocator.release([node.page])
+            self._n_pages -= 1
+            freed += 1
+            parent = node.parent
+            if parent is not self.root and not parent.children:
+                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        self.evictions += freed
+        return freed
+
+    def trim(self) -> int:
+        """LRU-evict down to the capacity budget; returns pages freed."""
+        over = self._n_pages - self.capacity_pages
+        return self.evict(over) if over > 0 else 0
+
+    def reclaimable(self) -> int:
+        """Pages evict() could free RIGHT NOW: maximal pendant subtrees
+        in which every node's page is referenced only by the tree. Used
+        by the engine's starvation reaper so a slot is never cut with
+        'length' while evictable cached pages could back it."""
+        count = 0
+
+        def visit(node: _Node) -> bool:
+            nonlocal count
+            # list() forces evaluation of every child (no short-circuit):
+            # siblings' counts must accrue even when one child is pinned.
+            oks = [visit(c) for c in list(node.children.values())]
+            if node is self.root:
+                return False
+            if all(oks) and self.allocator.refcount(node.page) == 1:
+                count += 1
+                return True
+            return False
+
+        visit(self.root)
+        return count

@@ -69,6 +69,45 @@ def test_health_and_models(server):
                                             "snowflake-arctic-embed-l"}
 
 
+def test_health_and_metrics_surface_prefix_cache_counters():
+    """With a prefix-cache-enabled engine, /health carries the cache
+    block and /metrics passes the hit/miss/evict counters through."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    class _Metrics:
+        prefix_hits, prefix_miss = 3, 1
+        prefix_evictions, prefix_hit_tokens = 2, 48
+
+        def snapshot(self):
+            return {"prefix_hits": 3, "prefix_miss": 1,
+                    "prefix_evictions": 2, "prefix_hit_tokens": 48,
+                    "prefill_tokens": 64}
+
+    class _Cache:
+        n_cached_pages = 5
+
+    class _LLM:
+        metrics = _Metrics()
+        prefix_cache = _Cache()
+
+    async def runner():
+        srv = OpenAIServer(_LLM())
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            h = await (await client.get("/health")).json()
+            m = await (await client.get("/metrics")).json()
+            return h, m
+        finally:
+            await client.close()
+
+    h, m = asyncio.run(runner())
+    assert h["prefix_cache"] == {
+        "enabled": True, "cached_pages": 5, "hits": 3, "misses": 1,
+        "evictions": 2, "hit_tokens": 48}
+    assert m["prefix_hits"] == 3 and m["prefix_hit_tokens"] == 48
+
+
 def test_chat_completion_non_streaming(server):
     async def body(c):
         r = await c.post("/v1/chat/completions", json={
