@@ -83,6 +83,7 @@ class ChainServer:
         self.app = web.Application(client_max_size=100 * 1024 * 1024)
         self.app.add_routes([
             web.get("/health", self.handle_health),
+            web.get("/metrics", self.handle_metrics),
             web.post("/generate", self.handle_generate),
             web.post("/documents", self.handle_upload),
             web.get("/documents", self.handle_list_documents),
@@ -100,6 +101,23 @@ class ChainServer:
         except Exception as e:
             return web.json_response({"message": f"unhealthy: {e}"}, status=503)
         return web.json_response({"message": "Service is up."})
+
+    # -- /metrics ----------------------------------------------------------
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Retrieval-side observability: the vector stores' counters
+        (searches, ann_probes / ann_scanned_rows / ann_recall_est /
+        index_rebuilds when the IVF index is live). The serving
+        engine's token metrics live on ITS /metrics
+        (serving/openai_server.py)."""
+        payload: Dict[str, Any] = {}
+        res = getattr(self.example, "res", None)
+        for key in ("store", "conv_store"):
+            store = getattr(res, key, None)
+            if store is not None and hasattr(store, "stats"):
+                payload[f"vector_{key}" if key == "store" else key] = \
+                    store.stats()
+        return web.json_response(payload)
 
     # -- /generate ---------------------------------------------------------
 

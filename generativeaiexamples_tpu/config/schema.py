@@ -33,7 +33,14 @@ class VectorStoreConfig:
     url: str = ""
     nlist: int = 64  # IVF cells (native/milvus backends)
     nprobe: int = 16  # IVF cells probed at search
+    # flat = exact brute-force MIPS (byte-identical to the pre-IVF
+    # store); ivf = TPU-native clustered ANN (ops/ivf.py): k-means
+    # centroids trained on device, searches refine only the top-nprobe
+    # of nlist partitions. Honored by the in-process tpu/native store.
     index_type: str = "flat"  # flat | ivf
+    # Store IVF rows as int8 + per-row scales (1/4 the f32 HBM
+    # footprint; ~1e-2 relative score error). ivf only.
+    quantize_int8: bool = False
     # Durable store directory ("ingested data persists across sessions",
     # reference CHANGELOG.md:63). Empty = ephemeral; deployments set it
     # (deploy/compose.env APP_VECTORSTORE_PERSISTDIR).

@@ -37,6 +37,11 @@ class Embedder(Protocol):
     def embed_query(self, text: str) -> np.ndarray:
         ...
 
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        """Batched query embedding — one engine dispatch for all of
+        multi-query retrieval's variants (Retriever.retrieve_batch)."""
+        ...
+
 
 class Reranker(Protocol):
     def score(self, query: str, passages: Sequence[str]) -> np.ndarray:

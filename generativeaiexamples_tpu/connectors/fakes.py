@@ -63,6 +63,10 @@ class HashEmbedder:
     def embed_query(self, text: str) -> np.ndarray:
         return self._vec(text)
 
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self._vec(t) for t in texts]) if len(texts) else \
+            np.zeros((0, self.dim), np.float32)
+
 
 class OverlapReranker:
     """Scores by word overlap — a monotone stand-in for a cross-encoder."""

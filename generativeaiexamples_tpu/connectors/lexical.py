@@ -72,3 +72,7 @@ class LexicalEmbedder:
 
     def embed_query(self, text: str) -> np.ndarray:
         return self._vec(text, idf=True)
+
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        return np.stack([self._vec(t, idf=True) for t in texts]) \
+            if len(texts) else np.zeros((0, self.dim), np.float32)

@@ -68,6 +68,9 @@ class LocalEmbedder:
     def embed_query(self, text: str) -> np.ndarray:
         return self.engine.embed([text], is_query=True)[0]
 
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        return self.engine.embed(list(texts), is_query=True)
+
 
 class LocalReranker:
     def __init__(self, engine):

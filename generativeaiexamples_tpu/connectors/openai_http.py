@@ -101,6 +101,9 @@ class OpenAIEmbedder:
     def embed_query(self, text: str) -> np.ndarray:
         return self._call([text], "query")[0]
 
+    def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
+        return self._call(list(texts), "query")
+
 
 class OpenAIReranker:
     """NIM-style /v1/ranking client (our server implements it too)."""

@@ -99,9 +99,10 @@ class BaseExample(abc.ABC):
             variants.append(aug.augment_query_generated(self.res.llm, q))
         if "multi_query" in modes:
             variants.extend(aug.augment_multiple_query(self.res.llm, q))
-        if len(variants) == 1:
-            return q, retrieve(q)
-        return q, aug.retrieve_fused(retrieve, variants, top_k=rcfg.top_k)
+        # All variants score in ONE device dispatch (store.search_batch
+        # via retrieve_multi), RRF-fused — not one matmul per variant.
+        return q, self.res.retriever.retrieve_multi(variants,
+                                                    top_k=rcfg.top_k)
 
     def answer_with_fact_check(self, query: str, context: str, token_iter
                                ) -> Generator[str, None, None]:

@@ -49,6 +49,8 @@ Question: {question}
 
 Reply with ONE json object only, no prose:
 {{"action": "search", "input": "<sub-question>"}}
+or {{"action": "search", "input": ["<sub-question>", "<sub-question>"]}}
+  (when several independent facts are needed at once)
 or {{"action": "math", "input": "<arithmetic expression>"}}
 or {{"action": "final", "answer": "<answer>"}}"""
 
@@ -121,18 +123,29 @@ def _parse_action(text: str) -> Dict:
 
 @register_example("query_decomposition")
 class QueryDecompositionAgent(QAChatbot):
+    def _search_many(self, sub_qs: List[str]) -> List[str]:
+        """Score ALL sub-questions against the store in ONE device
+        dispatch (retrieve_batch -> store.search_batch), then answer
+        each from its own context."""
+        batches = self.res.retriever.retrieve_batch(sub_qs,
+                                                    with_threshold=False)
+        answers = []
+        for sub_q, results in zip(sub_qs, batches):
+            results = self.res.retriever.limit_tokens(results, budget=400)
+            if not results:
+                answers.append("No relevant information found.")
+                continue
+            context = "\n".join(r.text for r in results)
+            answers.append(self.res.llm.chat([
+                {"role": "system",
+                 "content": "Answer briefly and only from the context.\n\n"
+                            f"Context:\n{context}"},
+                {"role": "user", "content": sub_q},
+            ], max_tokens=128))
+        return answers
+
     def _search(self, sub_q: str) -> str:
-        results = self.res.retriever.retrieve(sub_q, with_threshold=False)
-        results = self.res.retriever.limit_tokens(results, budget=400)
-        if not results:
-            return "No relevant information found."
-        context = "\n".join(r.text for r in results)
-        return self.res.llm.chat([
-            {"role": "system",
-             "content": "Answer briefly and only from the context.\n\n"
-                        f"Context:\n{context}"},
-            {"role": "user", "content": sub_q},
-        ], max_tokens=128)
+        return self._search_many([sub_q])[0]
 
     def _math(self, expr: str) -> str:
         try:
@@ -144,6 +157,8 @@ class QueryDecompositionAgent(QAChatbot):
                   ) -> Generator[str, None, None]:
         ledger = Ledger()
         depth = 0
+        searches_left = MAX_STEPS  # total sub-question budget: a list
+        # input must not multiply LLM calls past the scalar-input bound
         for _ in range(MAX_STEPS):
             reply = self.res.llm.chat([{
                 "role": "user",
@@ -153,9 +168,22 @@ class QueryDecompositionAgent(QAChatbot):
             act = _parse_action(reply)
             action = str(act.get("action", "final")).lower()
             if action == "search":
+                if searches_left <= 0:
+                    break
                 depth += 1
-                sub_q = str(act.get("input", query))
-                ledger.add(sub_q, self._search(sub_q))
+                raw = act.get("input", query)
+                # A list of sub-questions is scored in one batched
+                # store dispatch; a plain string is the 1-element case.
+                # Each entry spends the shared search budget — the list
+                # is model-supplied and must not amplify retrievals/LLM
+                # calls past what scalar inputs could reach.
+                sub_qs = ([str(s) for s in raw
+                           if str(s).strip()][:searches_left]
+                          if isinstance(raw, list) else [str(raw)])
+                sub_qs = sub_qs or [query]
+                searches_left -= len(sub_qs)
+                for sub_q, ans in zip(sub_qs, self._search_many(sub_qs)):
+                    ledger.add(sub_q, ans)
             elif action == "math":
                 expr = str(act.get("input", ""))
                 ledger.add(f"compute {expr}", self._math(expr))

@@ -99,20 +99,28 @@ def query_rewriting(llm, query: str,
     return out or query
 
 
-def retrieve_fused(search_fn, queries: Sequence[str], *,
-                   top_k: int = 4, rrf_k: int = 60) -> List:
-    """Reciprocal-rank-fusion over several query variants (multi-query/
-    HyDE results feed this). `search_fn(query) -> ranked hits` is the
-    pipeline's CONFIGURED retrieval path — fusion must not silently
-    bypass ranked_hybrid/thresholds by going straight to dense search.
-    Dedupes by text; empty when every variant came back empty (so the
+def fuse_ranked(hit_lists: Sequence[List], *, top_k: int = 4,
+                rrf_k: int = 60) -> List:
+    """Reciprocal-rank-fusion over pre-ranked hit lists (one per query
+    variant). Dedupes by text; empty when every list is empty (so the
     'no relevant documents' short-circuit still fires)."""
     scores: Dict[str, float] = {}
     hits_by_text: Dict[str, object] = {}
-    for q in queries:
-        for rank, hit in enumerate(search_fn(q)):
+    for hits in hit_lists:
+        for rank, hit in enumerate(hits):
             scores[hit.text] = scores.get(hit.text, 0.0) \
                 + 1.0 / (rrf_k + rank + 1)
             hits_by_text.setdefault(hit.text, hit)
     ranked = sorted(scores, key=scores.get, reverse=True)[:top_k]
     return [hits_by_text[t] for t in ranked]
+
+
+def retrieve_fused(search_fn, queries: Sequence[str], *,
+                   top_k: int = 4, rrf_k: int = 60) -> List:
+    """RRF over several query variants via `search_fn(query) -> ranked
+    hits`, the pipeline's CONFIGURED retrieval path — fusion must not
+    silently bypass ranked_hybrid/thresholds by going straight to dense
+    search. Prefer Retriever.retrieve_multi, which fuses the same way
+    but batches every dense leg into one device dispatch."""
+    return fuse_ranked([search_fn(q) for q in queries],
+                       top_k=top_k, rrf_k=rrf_k)

@@ -109,13 +109,86 @@ class Retriever:
             sp.set_attribute("n_results", len(results))
         return results
 
+    def retrieve_batch(self, queries: Sequence[str],
+                       top_k: Optional[int] = None,
+                       with_threshold: bool = True
+                       ) -> List[List[SearchResult]]:
+        """Dense retrieval for MANY queries in ONE device dispatch via
+        the store's search_batch (multi-query augmentation, hybrid
+        extra queries, decomposition sub-questions). Falls back to
+        sequential search for stores without a batch path (external
+        DBs). Result lists align with the query order; per-query
+        empty-result fallback retries without the threshold, matching
+        retrieve()."""
+        from generativeaiexamples_tpu.obs import tracing
+
+        k = top_k or self.top_k
+        thr = self.score_threshold if with_threshold else None
+        with tracing.span("retriever.retrieve_batch",
+                          {"top_k": k, "n_queries": len(queries)}) as sp:
+            # Batch the encoder stage too — it dominates end-to-end
+            # latency, so batching only the search matmul would leave
+            # most of the multi-query win on the table.
+            if hasattr(self.embedder, "embed_queries"):
+                qvs = np.asarray(self.embedder.embed_queries(list(queries)))
+            else:
+                qvs = np.stack([self.embedder.embed_query(q)
+                                for q in queries])
+            if hasattr(self.store, "search_batch"):
+                batches = self.store.search_batch(qvs, top_k=k,
+                                                  score_threshold=thr)
+            else:
+                batches = [self.store.search(qv, top_k=k,
+                                             score_threshold=thr)
+                           for qv in qvs]
+            if with_threshold and any(not b for b in batches):
+                retry = [i for i, b in enumerate(batches) if not b]
+                if hasattr(self.store, "search_batch"):
+                    redo = self.store.search_batch(qvs[retry], top_k=k,
+                                                   score_threshold=None)
+                else:
+                    redo = [self.store.search(qvs[i], top_k=k,
+                                              score_threshold=None)
+                            for i in retry]
+                for i, b in zip(retry, redo):
+                    batches[i] = b
+            sp.set_attribute("n_results", sum(len(b) for b in batches))
+        return batches
+
+    def retrieve_multi(self, queries: Sequence[str],
+                       top_k: Optional[int] = None) -> List[SearchResult]:
+        """Multi-query-variant retrieval through the CONFIGURED path
+        (hybrid included) with ONE dense dispatch, fused by RRF."""
+        from generativeaiexamples_tpu.rag.augmentation import fuse_ranked
+
+        k = top_k or self.top_k
+        if not queries:
+            return []
+        if len(queries) == 1:
+            return self.retrieve_default(queries[0], top_k=k)
+        if self.default_hybrid:
+            return self.retrieve_hybrid(queries[0], top_k=k,
+                                        extra_queries=queries[1:])
+        return fuse_ranked(self.retrieve_batch(queries, top_k=k), top_k=k)
+
     def retrieve_hybrid(self, query: str, top_k: Optional[int] = None,
                         candidates: int = 20,
-                        drop_outliers: bool = True) -> List[SearchResult]:
+                        drop_outliers: bool = True,
+                        extra_queries: Sequence[str] = ()
+                        ) -> List[SearchResult]:
         """ranked_hybrid: dense ∪ BM25 candidates -> cross-encoder rerank
-        -> stdev outlier drop (fm-asr retriever.py:64,99-110)."""
+        -> stdev outlier drop (fm-asr retriever.py:64,99-110). All dense
+        legs (`query` + `extra_queries` variants) score in ONE batched
+        device dispatch; reranking stays against the primary query."""
         k = top_k or self.top_k
-        dense = self.retrieve(query, top_k=candidates, with_threshold=False)
+        if extra_queries:
+            lists = self.retrieve_batch([query, *extra_queries],
+                                        top_k=candidates,
+                                        with_threshold=False)
+            dense = [hit for lst in lists for hit in lst]
+        else:
+            dense = self.retrieve(query, top_k=candidates,
+                                  with_threshold=False)
         docs = self.store.snapshot_docs()  # consistent view vs. ingestion
         merged = {r.text: r for r in dense}
         if docs:

@@ -1,18 +1,31 @@
-"""Vector stores: exact MIPS over numpy / TPU, with durable persistence.
+"""Vector stores: exact MIPS over numpy / TPU + TPU-native IVF ANN.
 
 Replaces the reference's external vector DBs (Milvus GPU_IVF_FLAT /
 pgvector; common/utils.py:158-243, docker-compose-vectordb.yaml). The
 primary backends are in-process:
 
-- MemoryVectorStore: numpy matmul top-k. Exact (recall 1.0 vs IVF's
-  approximate), fast to ~1M chunks on CPU.
+- MemoryVectorStore: numpy matmul top-k. Exact (recall 1.0), fast to
+  ~1M chunks on CPU.
 - TPUVectorStore: same interface, scores on the accelerator via
-  ops.topk (single-device or ShardedMIPSIndex over a mesh axis) —
-  the "TPU brute-force MIPS" option from SURVEY.md §7.4 item 6.
+  ops.topk (single-device or ShardedMIPSIndex over a mesh axis) — the
+  "TPU brute-force MIPS" option from SURVEY.md §7.4 item 6 — or, with
+  `vector_store.index_type=ivf`, the clustered two-stage ANN index in
+  ops/ivf.py (the GPU_IVF_FLAT role): coarse centroid scan, top-nprobe
+  partition refine, optional int8-quantized storage at 1/4 the HBM
+  footprint. `index_type=flat` (the default) is byte-identical to the
+  pre-IVF store.
+
+Both in-process stores expose `search_batch(queries, k)` so multi-query
+retrieval (hybrid candidates, query-decomposition sub-questions,
+multi-query augmentation) scores every query in ONE device dispatch,
+and `stats()` (ann_probes / ann_scanned_rows / ann_recall_est /
+index_rebuilds counters) that the chain server surfaces at /metrics.
 
 Durability matches the reference's "ingested data persists across
 sessions" feature (CHANGELOG.md:63): save()/load() to a directory
-(vectors.npz + docs.jsonl).
+(vectors.npz + docs.jsonl, + ivf.npz for a trained ANN index). Writes
+go through temp files + os.replace so a crash mid-persist never
+corrupts the durable snapshot.
 
 Documents carry {text, metadata{filename, ...}}; deletion is by
 filename, mirroring the reference's /documents DELETE semantics
@@ -37,6 +50,30 @@ class SearchResult:
     metadata: Dict = field(default_factory=dict)
 
 
+# Below this corpus size an IVF index buys nothing (one coarse scan
+# would cost as much as the exact matmul) — the store stays on the
+# exact path and trains lazily once the corpus grows past it.
+IVF_MIN_ROWS = 256
+# Retrain when the corpus grew by this fraction since training: the
+# centroids no longer describe the data (incremental adds only assign).
+IVF_REBUILD_GROWTH = 0.5
+# Every Nth ANN search also runs the exact scorer on the host and folds
+# top-k overlap into the running ann_recall_est gauge.
+RECALL_SAMPLE_EVERY = 32
+
+
+def _atomic_replace(path: str, write_fn) -> None:
+    """Write via `write_fn(tmp_path)` then os.replace into place — a
+    crash mid-write leaves the previous snapshot intact."""
+    tmp = path + ".tmp"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 class MemoryVectorStore:
     """Exact cosine/IP search over an [N, D] matrix. Thread-safe.
 
@@ -52,6 +89,8 @@ class MemoryVectorStore:
         self._vecs = np.zeros((0, dim), np.float32)
         self._docs: List[Dict] = []
         self._lock = threading.RLock()
+        self._n_searches = 0
+        self._n_batched = 0
         self.persist_dir = persist_dir or None
         if self.persist_dir:
             self._load_from(self.persist_dir)
@@ -88,18 +127,73 @@ class MemoryVectorStore:
         with self._lock:
             if not self._docs:
                 return []
-            scores = self._scores(query_embedding)
-            k = min(top_k, len(scores))
-            idx = np.argpartition(scores, -k)[-k:]
-            idx = idx[np.argsort(scores[idx])[::-1]]
-            out = []
-            for i in idx:
-                s = float(scores[i])
-                if score_threshold is not None and s < score_threshold:
-                    continue
-                d = self._docs[i]
-                out.append(SearchResult(d["text"], s, dict(d["metadata"])))
-            return out
+            self._n_searches += 1
+            return self._topk_from_scores(self._scores(query_embedding),
+                                          top_k, score_threshold)
+
+    def search_batch(self, query_embeddings: np.ndarray, top_k: int = 4,
+                     score_threshold: Optional[float] = None
+                     ) -> List[List[SearchResult]]:
+        """Score ALL queries ([Q, D]) in one pass. Result lists align
+        with the query order. A single-row batch delegates to search()
+        so batched and sequential results are identical."""
+        qs = np.asarray(query_embeddings, np.float32)
+        if qs.ndim != 2:
+            raise ValueError(f"query_embeddings must be [Q, D], got "
+                             f"{qs.shape}")
+        if len(qs) == 1:
+            return [self.search(qs[0], top_k=top_k,
+                                score_threshold=score_threshold)]
+        with self._lock:
+            if not self._docs:
+                return [[] for _ in qs]
+            self._n_batched += 1
+            self._n_searches += len(qs)
+            # One [Q,D]x[D,N] GEMM (and for cosine ONE corpus
+            # normalization) instead of Q matrix-vector passes.
+            if self.metric == "cosine":
+                qn = qs / np.clip(np.linalg.norm(qs, axis=1, keepdims=True),
+                                  1e-12, None)
+                dn = self._vecs / np.clip(
+                    np.linalg.norm(self._vecs, axis=1, keepdims=True),
+                    1e-12, None)
+                all_scores = qn @ dn.T
+            else:
+                all_scores = qs @ self._vecs.T
+            return [self._topk_from_scores(row, top_k, score_threshold)
+                    for row in all_scores]
+
+    def _topk_from_scores(self, scores, top_k, score_threshold):
+        k = min(top_k, len(scores))
+        idx = np.argpartition(scores, -k)[-k:]
+        idx = idx[np.argsort(scores[idx])[::-1]]
+        out = []
+        for i in idx:
+            s = float(scores[i])
+            if score_threshold is not None and s < score_threshold:
+                continue
+            d = self._docs[i]
+            out.append(SearchResult(d["text"], s, dict(d["metadata"])))
+        return out
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Counters the chain server surfaces at /metrics. The exact
+        stores report zeros for the ANN gauges (nothing approximate to
+        count); TPUVectorStore overrides them when IVF is live."""
+        with self._lock:
+            return {
+                "backend": type(self).__name__,
+                "index": "flat",
+                "ntotal": len(self._docs),
+                "searches": self._n_searches,
+                "batched_searches": self._n_batched,
+                "ann_probes": 0,
+                "ann_scanned_rows": 0,
+                "ann_recall_est": None,
+                "index_rebuilds": 0,
+            }
 
     # -- document management ----------------------------------------------
 
@@ -135,15 +229,27 @@ class MemoryVectorStore:
     def save(self, path: str) -> None:
         with self._lock:
             os.makedirs(path, exist_ok=True)
-            np.savez_compressed(os.path.join(path, "vectors.npz"),
-                                vecs=self._vecs)
-            with open(os.path.join(path, "docs.jsonl"), "w") as fh:
-                for d in self._docs:
-                    fh.write(json.dumps(d) + "\n")
+            vecs, docs = self._vecs, list(self._docs)
+
+            def write_vecs(tmp):
+                with open(tmp, "wb") as fh:
+                    np.savez_compressed(fh, vecs=vecs)
+
+            def write_docs(tmp):
+                with open(tmp, "w") as fh:
+                    for d in docs:
+                        fh.write(json.dumps(d) + "\n")
+
+            _atomic_replace(os.path.join(path, "vectors.npz"), write_vecs)
+            _atomic_replace(os.path.join(path, "docs.jsonl"), write_docs)
+            self._save_extra(path)
+
+    def _save_extra(self, path: str) -> None:
+        pass  # hook for index sidecars (TPUVectorStore's ivf.npz)
 
     @classmethod
-    def load(cls, path: str, dim: int, metric: str = "ip"):
-        store = cls(dim, metric)
+    def load(cls, path: str, dim: int, metric: str = "ip", **kwargs):
+        store = cls(dim, metric, **kwargs)
         store._load_from(path)
         return store
 
@@ -154,7 +260,11 @@ class MemoryVectorStore:
             self._vecs = np.load(vp)["vecs"].astype(np.float32)
             with open(dp) as fh:
                 self._docs = [json.loads(ln) for ln in fh if ln.strip()]
+            self._load_extra(path)
             self._on_update()
+
+    def _load_extra(self, path: str) -> None:
+        pass
 
     def _persist(self) -> None:
         if self.persist_dir:
@@ -166,29 +276,116 @@ class MemoryVectorStore:
 
 class TPUVectorStore(MemoryVectorStore):
     """Same interface; scoring runs on the accelerator. The device copy
-    is refreshed lazily after mutations (ingest batches, then search)."""
+    is refreshed lazily after mutations (ingest batches, then search).
+
+    `index_type="flat"` (default) is exact brute-force MIPS, unchanged
+    from the pre-IVF store. `index_type="ivf"` trains a k-means
+    clustered index (ops/ivf.py) once the corpus passes IVF_MIN_ROWS:
+    searches scan only the top-`nprobe` of `nlist` partitions,
+    incremental add() assigns new rows without retraining or
+    re-transferring the corpus, deletes (row ids shift) and >50% growth
+    trigger a rebuild, and `quantize_int8` stores rows as int8 +
+    per-row scales (1/4 the f32 HBM footprint). With a mesh, flat uses
+    ShardedMIPSIndex and IVF uses ShardedIVFIndex (partitions split
+    across the mesh axis)."""
 
     def __init__(self, dim: int, metric: str = "ip", mesh=None,
                  shard_axis: str = "tensor",
-                 persist_dir: Optional[str] = None):
+                 persist_dir: Optional[str] = None, *,
+                 index_type: str = "flat", nlist: int = 64,
+                 nprobe: int = 16, quantize_int8: bool = False):
+        if index_type not in ("flat", "ivf"):
+            raise ValueError(
+                f"index_type={index_type!r} not supported; use flat | ivf")
         self.mesh = mesh
         self.shard_axis = shard_axis
+        self.index_type = index_type
+        self.nlist = int(nlist)
+        self.nprobe = int(nprobe)
+        self.quantize_int8 = bool(quantize_int8)
         self._device_index = None
+        self._ivf = None
+        self._ivf_synced_rows = 0   # rows already in the device index
+        self._ivf_trained_rows = 0  # corpus size when centroids trained
+        self._ivf_stale = False     # row ids shifted (delete) -> rebuild
+        self._loaded_ivf_state = None  # persisted centroids/assignments
         self._dirty = True
+        self._ann_probes = 0
+        self._ann_scanned = 0
+        self._rebuilds = 0
+        self._recall_sum = 0.0
+        self._recall_n = 0
+        self._pending_sample = None
+        self._pending_sidecar = None
+        # Per-store sampling cadence (bench raises it so the gauge's
+        # exact reference scan stays out of timed windows).
+        self.recall_sample_every = RECALL_SAMPLE_EVERY
         super().__init__(dim, metric, persist_dir=persist_dir)
 
     def _on_update(self) -> None:
         self._dirty = True
 
-    def _refresh(self) -> None:
-        import jax.numpy as jnp
+    def delete_documents(self, filenames: Sequence[str]) -> int:
+        with self._lock:
+            removed = super().delete_documents(filenames)
+            if removed:
+                # Compaction shifted row ids: every partition assignment
+                # is invalid — including a not-yet-consumed persisted
+                # snapshot, whose row-count check alone could pass again
+                # after later adds. (A no-op delete keeps the index —
+                # nothing moved.)
+                self._ivf_stale = True
+                self._loaded_ivf_state = None
+            if not self._docs and self._ivf is not None:
+                # Emptied out: drop the index now — an empty store never
+                # refreshes (search short-circuits), so stats would keep
+                # reporting a live index.
+                self._ivf = None
+                self._ivf_stale = False
+                self._ivf_synced_rows = 0
+            return removed
 
+    # -- device index lifecycle -------------------------------------------
+
+    def _normalized(self, vecs: np.ndarray) -> np.ndarray:
+        if self.metric == "cosine":
+            return vecs / np.clip(
+                np.linalg.norm(vecs, axis=1, keepdims=True), 1e-12, None)
+        return vecs
+
+    def _refresh(self) -> None:
         if not self._dirty:
             return
-        vecs = self._vecs
-        if self.metric == "cosine":
-            vecs = vecs / np.clip(np.linalg.norm(vecs, axis=1, keepdims=True),
-                                  1e-12, None)
+        wants_ivf = (self.index_type == "ivf"
+                     and len(self._vecs) >= IVF_MIN_ROWS)
+        if wants_ivf and self._ivf is not None and not self._ivf_stale:
+            self._sync_ivf_incremental()
+        if wants_ivf and self._ivf is not None and not self._ivf_stale:
+            self._device_index = None  # the flat mirror is superseded
+            # A sharded index lagging the corpus (re-layout is off-lock
+            # work) keeps the dirty flag: this query serves the rows it
+            # has; the next search's trainer folds the tail in.
+            self._dirty = self._ivf_synced_rows < len(self._vecs)
+            return
+        # Exact path: no index wanted, corpus below the floor, or the
+        # index is stale/untrained (training happens OUTSIDE the lock
+        # in _maybe_train_ivf — this is the correct fallback when a
+        # mutation raced it).
+        if wants_ivf and self._ivf is not None:
+            # Dropping a live index (overflow/raced mutation): the
+            # retrain happens at the next search's off-lock trainer;
+            # count the rebuild here, where it is forced.
+            self._rebuilds += 1
+        self._ivf = None
+        self._ivf_stale = False
+        self._ivf_synced_rows = 0
+        self._refresh_flat()
+        self._dirty = False
+
+    def _refresh_flat(self) -> None:
+        import jax.numpy as jnp
+
+        vecs = self._normalized(self._vecs)
         if self.mesh is not None and len(vecs):
             from generativeaiexamples_tpu.ops.topk import ShardedMIPSIndex
 
@@ -196,33 +393,347 @@ class TPUVectorStore(MemoryVectorStore):
                                                   self.shard_axis)
         else:
             self._device_index = jnp.asarray(vecs) if len(vecs) else None
-        self._dirty = False
+
+    def _sync_ivf_incremental(self) -> None:
+        """Fold rows added since the last sync into a SINGLE-DEVICE
+        index (one assign matmul + tail-slot scatter — lock-held
+        because it is cheap). The sharded layout's sync re-ships the
+        corpus, so it runs through the off-lock trainer instead; here
+        it is a no-op and _refresh keeps the dirty flag up. An add that
+        would skew a partition past the table's growth cap is refused
+        by the index; mark stale so the next search retrains off-lock."""
+        from generativeaiexamples_tpu.ops import ivf as ivf_ops
+
+        n = len(self._vecs)
+        if n <= self._ivf_synced_rows or \
+                isinstance(self._ivf, ivf_ops.ShardedIVFIndex):
+            return
+        new = self._normalized(self._vecs[self._ivf_synced_rows:])
+        if not self._ivf.add(new):
+            self._ivf_stale = True  # rebuild at next search (off-lock)
+            return
+        self._ivf_synced_rows = n
+        if self.persist_dir:
+            # The add-time save skipped (and removed) the sidecar while
+            # the index lagged the corpus; it is current again now.
+            # Written after the lock drops (caller flushes).
+            self._pending_sidecar = self._ivf.state()
+
+    def _ivf_needs_train(self) -> bool:
+        """Lock held. True when a (re)train is due: no index yet, row
+        ids shifted (delete/overflow), >50% growth since training, or a
+        skewed table (padding = wasted refine bandwidth)."""
+        n = len(self._vecs)
+        if self.index_type != "ivf" or n < IVF_MIN_ROWS:
+            return False
+        if self._ivf is None or self._ivf_stale:
+            return True
+        if self._ivf_trained_rows and \
+                (n - self._ivf_trained_rows) / self._ivf_trained_rows \
+                > IVF_REBUILD_GROWTH:
+            return True
+        return self._ivf.max_list_len > 4 * max(1, n // self._ivf.nlist)
+
+    def _ivf_wants_relayout(self) -> bool:
+        """Lock held. A live SHARDED index lagging the corpus: folding
+        rows in means rebuilding the per-shard blocks (a corpus
+        re-ship), which must happen off-lock like training."""
+        from generativeaiexamples_tpu.ops import ivf as ivf_ops
+
+        return (isinstance(self._ivf, ivf_ops.ShardedIVFIndex)
+                and not self._ivf_stale
+                and self._ivf_synced_rows < len(self._vecs))
+
+    def _maybe_train_ivf(self) -> None:
+        """Train/rebuild/re-layout the IVF index WITHOUT holding the
+        store lock: k-means (or the sharded layout re-ship) over a
+        corpus snapshot runs for seconds at scale — concurrent searches
+        and ingests must not queue behind it — then the result installs
+        under the lock. A delete racing the build shifts row ids and
+        voids the snapshot's assignments — detected via _ivf_stale and
+        retried; adds during the build are fine (the next search picks
+        the tail up). Two concurrent trainers waste work but stay
+        correct (last install wins)."""
+        from generativeaiexamples_tpu.ops import ivf as ivf_ops
+
+        if self.index_type != "ivf":
+            return
+        sidecar = None
+        for _ in range(3):
+            with self._lock:
+                needs = self._ivf_needs_train()
+                relayout = not needs and self._ivf_wants_relayout()
+                if not needs and not relayout:
+                    break
+                rebuilding = needs and self._ivf is not None
+                vecs = self._vecs
+                n = len(vecs)
+                trained_rows = self._ivf_trained_rows
+                if relayout:
+                    # Reuse the live index's training verbatim; only the
+                    # tail rows need assigning.
+                    state = dict(self._ivf.state())
+                elif rebuilding:
+                    self._loaded_ivf_state = None
+                    state = {}
+                else:
+                    state = self._loaded_ivf_state or {}
+                    if state.get("assignments") is not None and \
+                            len(state["assignments"]) != n:
+                        state = {}  # snapshot predates later mutations
+                self._ivf_stale = False  # building against this snapshot
+            # -- slow part: no lock held --------------------------------
+            norm = self._normalized(vecs)
+            if relayout:
+                old_n = len(state["assignments"])
+                a = np.asarray(ivf_ops.assign_partitions(
+                    norm[old_n:], state["centroids"]))
+                state["assignments"] = np.concatenate(
+                    [state["assignments"], a])
+                counts = np.bincount(
+                    state["assignments"],
+                    minlength=len(state["centroids"]))
+                if counts.max() > 4 * max(
+                        1, n // len(state["centroids"])):
+                    # Hot-partition skew: fall back to a full retrain
+                    # (same trigger IVFIndex.add refuses on).
+                    state, relayout, rebuilding = {}, False, True
+            # Partitions need enough rows to be worth probing; clamp
+            # nlist so the average list holds >= 8 rows.
+            nlist = max(1, min(self.nlist, n // 8))
+            kw = dict(nprobe=self.nprobe,
+                      quantize_int8=self.quantize_int8,
+                      centroids=state.get("centroids"),
+                      assignments=state.get("assignments"))
+            if self.mesh is not None:
+                built = ivf_ops.ShardedIVFIndex(norm, nlist, self.mesh,
+                                                self.shard_axis, **kw)
+            else:
+                built = ivf_ops.IVFIndex(norm, nlist, **kw)
+            with self._lock:
+                if self._ivf_stale or len(self._vecs) < n:
+                    continue  # a delete raced the build: retry
+                self._ivf = built
+                self._ivf_synced_rows = n
+                self._ivf_trained_rows = n if not relayout else \
+                    (trained_rows or n)
+                self._device_index = None
+                # Rows added DURING the build are not in the snapshot;
+                # force the next refresh to fold them in.
+                self._dirty = True
+                if rebuilding:
+                    self._rebuilds += 1
+                if self.persist_dir:
+                    # Training happens at search time, not mutation time
+                    # — persist the sidecar (outside the lock, below) so
+                    # a restart reloads centroids instead of re-running
+                    # k-means.
+                    sidecar = built.state()
+                break
+        else:
+            # Deletes keep racing the trainer (pathological): give up
+            # for this query — search serves the exact flat path, which
+            # is always correct — and let a later search try again.
+            return
+        if sidecar is not None:
+            self._write_sidecar(sidecar)
+
+    # -- search ------------------------------------------------------------
+
+    def _device_search(self, qs: np.ndarray, k: int):
+        """One device dispatch for [Q, D] queries -> (scores [Q,k],
+        ids [Q,k]) host arrays; updates the ANN counters. Every
+        RECALL_SAMPLE_EVERYth query queues a recall sample the caller
+        runs AFTER releasing the lock (the exact reference scan is
+        O(N*D) on the host and must not block concurrent searches)."""
+        if self._ivf is not None:
+            scores, idx, scanned = self._ivf.search(qs, k)
+            self._ann_probes += len(qs) * self._ivf.nprobe
+            self._ann_scanned += scanned
+            if self._n_searches % self.recall_sample_every == 0:
+                # _vecs is replaced on mutation, never written in place,
+                # so the snapshot reference is safe to scan lock-free.
+                self._pending_sample = (np.array(qs[0], copy=True),
+                                        np.asarray(idx)[0].copy(), k,
+                                        self._vecs)
+            return np.asarray(scores), np.asarray(idx)
+        if hasattr(self._device_index, "search"):
+            scores, idx = self._device_index.search(qs, k)
+        else:
+            from generativeaiexamples_tpu.ops.topk import mips_topk
+
+            scores, idx = mips_topk(qs, self._device_index, k)
+        return np.asarray(scores), np.asarray(idx)
+
+    def _pop_pending_sample(self):
+        sample = getattr(self, "_pending_sample", None)
+        self._pending_sample = None
+        return sample
+
+    def _pop_pending_sidecar(self):
+        state = getattr(self, "_pending_sidecar", None)
+        self._pending_sidecar = None
+        return state
+
+    @staticmethod
+    def _dump_ivf_state(path: str, state: Dict) -> None:
+        """The one ivf.npz writer (atomic): both the lock-held save()
+        path and the deferred search-path writer go through it, so the
+        sidecar format cannot fork."""
+        os.makedirs(path, exist_ok=True)
+
+        def write(tmp):
+            with open(tmp, "wb") as fh:
+                np.savez_compressed(fh, **state)
+
+        _atomic_replace(os.path.join(path, "ivf.npz"), write)
+
+    def _write_sidecar(self, state: Dict) -> None:
+        """Persist IVF training state (no lock needed: `state` is a
+        snapshot; np.savez_compressed of a large assignments array is
+        too slow for the lock-held search path). A racing
+        mutation-save may remove/replace the file — benign, the loader
+        validates row counts."""
+        if not self.persist_dir:
+            return
+        self._dump_ivf_state(self.persist_dir, state)
+
+    def _run_recall_sample(self, q: np.ndarray, ann_idx: np.ndarray,
+                           k: int, vecs: np.ndarray) -> None:
+        """Fold one exact-vs-ANN overlap@k sample into the recall gauge.
+        Runs outside the store lock; avoids materializing a normalized
+        corpus copy (row norms divide the score vector instead)."""
+        scores = vecs @ np.asarray(q, np.float32)
+        if self.metric == "cosine":
+            scores = scores / np.clip(np.linalg.norm(vecs, axis=1),
+                                      1e-12, None)
+        kk = min(k, len(scores))
+        truth = set(np.argpartition(scores, -kk)[-kk:].tolist())
+        got = [int(i) for i in ann_idx[:kk] if 0 <= int(i) < len(scores)]
+        with self._lock:
+            self._recall_sum += len(truth.intersection(got)) \
+                / max(1, len(truth))
+            self._recall_n += 1
+
+    def _prep_query(self, q: np.ndarray) -> np.ndarray:
+        q = np.asarray(q, np.float32)
+        if self.metric == "cosine":
+            norms = np.clip(np.linalg.norm(q, axis=-1, keepdims=True),
+                            1e-12, None)
+            q = q / norms
+        return q
+
+    def _collect(self, scores, idx, score_threshold) -> List[SearchResult]:
+        out = []
+        for s, i in zip(scores, idx):
+            i = int(i)
+            # IVF pads short candidate sets with sentinel ids / -inf.
+            if i < 0 or i >= len(self._docs) or not np.isfinite(s):
+                continue
+            if score_threshold is not None and float(s) < score_threshold:
+                continue
+            d = self._docs[i]
+            out.append(SearchResult(d["text"], float(s), dict(d["metadata"])))
+        return out
 
     def search(self, query_embedding: np.ndarray, top_k: int = 4,
                score_threshold: Optional[float] = None) -> List[SearchResult]:
+        self._maybe_train_ivf()  # slow k-means runs before we lock
         with self._lock:
             if not self._docs:
                 return []
             self._refresh()
-            q = np.asarray(query_embedding, np.float32)
-            if self.metric == "cosine":
-                q = q / max(np.linalg.norm(q), 1e-12)
+            self._n_searches += 1
+            q = self._prep_query(query_embedding)
             k = min(top_k, len(self._docs))
-            if isinstance(self._device_index, object) and hasattr(
-                    self._device_index, "search"):
-                scores, idx = self._device_index.search(q[None, :], k)
-            else:
-                from generativeaiexamples_tpu.ops.topk import mips_topk
+            scores, idx = self._device_search(q[None, :], k)
+            out = self._collect(scores[0], idx[0], score_threshold)
+            sample = self._pop_pending_sample()
+            sidecar = self._pop_pending_sidecar()
+        if sidecar is not None:
+            self._write_sidecar(sidecar)
+        if sample:
+            self._run_recall_sample(*sample)
+        return out
 
-                scores, idx = mips_topk(q[None, :], self._device_index, k)
-            out = []
-            for s, i in zip(np.asarray(scores)[0], np.asarray(idx)[0]):
-                if score_threshold is not None and float(s) < score_threshold:
-                    continue
-                d = self._docs[int(i)]
-                out.append(SearchResult(d["text"], float(s),
-                                        dict(d["metadata"])))
+    def search_batch(self, query_embeddings: np.ndarray, top_k: int = 4,
+                     score_threshold: Optional[float] = None
+                     ) -> List[List[SearchResult]]:
+        """All queries scored in ONE device dispatch (one matmul for
+        flat, one probe+refine for IVF) instead of one per query."""
+        qs = np.asarray(query_embeddings, np.float32)
+        if qs.ndim != 2:
+            raise ValueError(f"query_embeddings must be [Q, D], got "
+                             f"{qs.shape}")
+        self._maybe_train_ivf()  # slow k-means runs before we lock
+        with self._lock:
+            if not self._docs:
+                return [[] for _ in qs]
+            self._refresh()
+            self._n_batched += 1
+            self._n_searches += len(qs)
+            qs = self._prep_query(qs)
+            k = min(top_k, len(self._docs))
+            scores, idx = self._device_search(qs, k)
+            out = [self._collect(s, i, score_threshold)
+                   for s, i in zip(scores, idx)]
+            sample = self._pop_pending_sample()
+            sidecar = self._pop_pending_sidecar()
+        if sidecar is not None:
+            self._write_sidecar(sidecar)
+        if sample:
+            self._run_recall_sample(*sample)
+        return out
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            out = super().stats()
+            live = "ivf" if self._ivf is not None else "flat"
+            if self.index_type == "ivf" and live == "flat":
+                live = "flat(ivf pending)"  # corpus below IVF_MIN_ROWS
+            out.update({
+                "index": live,
+                "nlist": self._ivf.nlist if self._ivf is not None else None,
+                "nprobe": self.nprobe,
+                "quantize_int8": self.quantize_int8,
+                "ann_probes": self._ann_probes,
+                "ann_scanned_rows": self._ann_scanned,
+                "ann_recall_est": (round(self._recall_sum / self._recall_n, 4)
+                                   if self._recall_n else None),
+                "index_rebuilds": self._rebuilds,
+            })
             return out
+
+    # -- persistence -------------------------------------------------------
+
+    def _save_extra(self, path: str) -> None:
+        """Persist the trained IVF state next to the corpus, so a
+        reload skips k-means. Skipped (and any stale sidecar removed)
+        when the index lags the corpus — the loader would mis-assign."""
+        ip = os.path.join(path, "ivf.npz")
+        if self._ivf is None or self._ivf_synced_rows != len(self._vecs):
+            if os.path.exists(ip):
+                os.unlink(ip)
+            return
+        self._dump_ivf_state(path, self._ivf.state())
+
+    def _load_extra(self, path: str) -> None:
+        ip = os.path.join(path, "ivf.npz")
+        if self.index_type != "ivf" or not os.path.isfile(ip):
+            return
+        with np.load(ip) as z:
+            state = {"centroids": z["centroids"].astype(np.float32),
+                     "assignments": z["assignments"].astype(np.int32)}
+        # The snapshot must match the corpus AND the configured index
+        # geometry — IVFIndex takes nlist from the loaded centroids, so
+        # accepting a stale shape would silently pin the old nlist
+        # against a retuned config.
+        expected_nlist = max(1, min(self.nlist, len(self._vecs) // 8))
+        if len(state["assignments"]) == len(self._vecs) and \
+                state["centroids"].shape == (expected_nlist, self.dim):
+            self._loaded_ivf_state = state
 
 
 def create_vector_store(config, dim: Optional[int] = None, mesh=None,
@@ -238,25 +749,36 @@ def create_vector_store(config, dim: Optional[int] = None, mesh=None,
     anything else is rejected with a clear error rather than silently
     remapped (VERDICT r2 missing #3).
 
+    The in-process TPU store honors the IVF knobs (`index_type`,
+    `nlist`, `nprobe`, `quantize_int8`); external stores configure
+    their index server-side.
+
     `persist_dir` (usually config.vector_store.persist_dir) makes the
     in-process stores durable; external stores are durable server-side.
     `ephemeral=True` marks per-process scratch stores (conversation
     memory): those stay in-process even under milvus — otherwise every
     server process would write its private conversation turns into the
     shared durable document collection and retrieval would serve them
-    back as knowledge-base context."""
-    name = config.vector_store.name
+    back as knowledge-base context. Scratch stores also stay on the
+    exact flat path — conversation memory is far below IVF scale."""
+    vs = config.vector_store
+    name = vs.name
     dim = dim or config.embeddings.dimensions
     if name == "milvus" and not ephemeral:
         from generativeaiexamples_tpu.rag.milvus_store import MilvusVectorStore
 
-        return MilvusVectorStore(config.vector_store.url, dim)
+        return MilvusVectorStore(vs.url, dim)
     if name == "pgvector" and not ephemeral:
         from generativeaiexamples_tpu.rag.pgvector_store import PgVectorStore
 
-        return PgVectorStore(config.vector_store.url, dim)
+        return PgVectorStore(vs.url, dim)
     if name in ("tpu", "native"):
-        return TPUVectorStore(dim, mesh=mesh, persist_dir=persist_dir)
+        if ephemeral:
+            return TPUVectorStore(dim, mesh=mesh)
+        return TPUVectorStore(dim, mesh=mesh, persist_dir=persist_dir,
+                              index_type=vs.index_type, nlist=vs.nlist,
+                              nprobe=vs.nprobe,
+                              quantize_int8=vs.quantize_int8)
     if name == "memory" or (ephemeral and name in ("milvus", "pgvector")):
         return MemoryVectorStore(dim, persist_dir=persist_dir)
     raise ValueError(

@@ -1716,6 +1716,8 @@ class LLMEngine:
         slots free pool pages — so finishing unconditionally here would
         truncate streams with reason "length" while pages are free."""
         r = (self._spec_k + 1) if self._spec_k else 1
+        reclaimable_pages = None  # computed at most once per pass: the
+        # tree cannot change between iterations of this scheduler loop
         for i, slot in enumerate(self.slots):
             if slot is None or not slot.no_capacity:
                 continue
@@ -1730,8 +1732,9 @@ class LLMEngine:
                 # allocator's reclaim hook evicts inside alloc); a slot
                 # must not be cut with 'length' while they could back
                 # it. Slow path only — reclaimable() walks the tree.
-                avail += self.prefix_cache.reclaimable() * \
-                    self.pool.page_size
+                if reclaimable_pages is None:
+                    reclaimable_pages = self.prefix_cache.reclaimable()
+                avail += reclaimable_pages * self.pool.page_size
             if table_cap >= r and avail >= r:
                 slot.no_capacity = False
                 continue

@@ -112,6 +112,25 @@ def build_schema() -> dict:
                     }},
                 },
             },
+            "/metrics": {
+                "get": {
+                    "summary": "Retrieval Metrics",
+                    "description": "Vector-store counters: searches, "
+                                   "batched dispatches, and the ANN "
+                                   "gauges (ann_probes, "
+                                   "ann_scanned_rows, ann_recall_est, "
+                                   "index_rebuilds) when the IVF index "
+                                   "is live.",
+                    "operationId": "retrieval_metrics_metrics_get",
+                    "responses": {"200": {
+                        "description": "per-store stats keyed by store "
+                                       "role (vector_store, conv_store)",
+                        "content": {"application/json": {"schema": {
+                            "$ref": "#/components/schemas/"
+                                    "MetricsResponse"}}}},
+                    },
+                },
+            },
             "/generate": {
                 "post": {
                     "summary": "Generate Answer",
@@ -206,6 +225,27 @@ def build_schema() -> dict:
             "HealthResponse": {
                 "type": "object", "title": "HealthResponse",
                 "properties": {"message": {"type": "string", "default": ""}},
+            },
+            "MetricsResponse": {
+                "type": "object", "title": "MetricsResponse",
+                "description": "Vector-store stats() snapshots keyed by "
+                               "store role.",
+                "additionalProperties": {
+                    "type": "object",
+                    "properties": {
+                        "backend": {"type": "string"},
+                        "index": {"type": "string",
+                                  "description": "flat | ivf | "
+                                                 "flat(ivf pending)"},
+                        "ntotal": {"type": "integer"},
+                        "searches": {"type": "integer"},
+                        "batched_searches": {"type": "integer"},
+                        "ann_probes": {"type": "integer"},
+                        "ann_scanned_rows": {"type": "integer"},
+                        "ann_recall_est": {"type": ["number", "null"]},
+                        "index_rebuilds": {"type": "integer"},
+                    },
+                },
             },
             **_VALIDATION,
         }},

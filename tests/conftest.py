@@ -26,6 +26,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# jax-version compat: newer jax spells the ambient-mesh context
+# `jax.set_mesh(mesh)`; on older jax the Mesh object is itself the
+# context manager, so the identity shim keeps `with jax.set_mesh(m):`
+# working across both.
+if not hasattr(jax, "set_mesh"):
+    jax.set_mesh = lambda mesh: mesh
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,421 @@
+"""TPU-native IVF ANN index: recall, incremental add, rebuild triggers,
+int8 quantization, sharded layout, batched search, crash-safe persist.
+
+All device paths run on the emulated CPU backend (conftest) — the same
+jit/shard_map code that runs on TPU.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.rag import vectorstore as vs_mod
+from generativeaiexamples_tpu.rag.vectorstore import (
+    MemoryVectorStore, TPUVectorStore)
+
+DIM = 32
+N_CLUSTERS = 48
+SEED = 7
+
+
+def _clustered(n, dim=DIM, n_clusters=N_CLUSTERS, sigma=0.15, seed=SEED):
+    """Synthetic clustered corpus (unit-norm rows) — the shape IVF is
+    built for; queries drawn near cluster centers."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    data = centers[rng.integers(0, n_clusters, n)] + \
+        sigma * rng.standard_normal((n, dim)).astype(np.float32)
+    data /= np.linalg.norm(data, axis=1, keepdims=True)
+    return data.astype(np.float32)
+
+
+def _fill(store, vecs, filename="corpus.txt"):
+    store.add([f"chunk-{i}" for i in range(len(vecs))], vecs,
+              [{"filename": filename, "row": i} for i in range(len(vecs))])
+
+
+def _ivf_store(vecs, **kw):
+    kw.setdefault("index_type", "ivf")
+    store = TPUVectorStore(DIM, **kw)
+    _fill(store, vecs)
+    return store
+
+
+def _recall(store, flat_store, queries, k=4):
+    hits = 0.0
+    for q in queries:
+        got = {r.text for r in store.search(q, top_k=k)}
+        truth = {r.text for r in flat_store.search(q, top_k=k)}
+        hits += len(got & truth) / max(1, len(truth))
+    return hits / len(queries)
+
+
+class TestKMeans:
+    def test_shapes_and_clamping(self):
+        from generativeaiexamples_tpu.ops.ivf import kmeans_fit
+
+        data = _clustered(300)
+        c, a = kmeans_fit(data, 16, iters=4)
+        assert c.shape == (16, DIM) and a.shape == (300,)
+        assert a.min() >= 0 and a.max() < 16
+        # nlist clamps to N
+        c2, a2 = kmeans_fit(data[:5], 64)
+        assert c2.shape[0] == 5
+
+    def test_finds_cluster_structure(self):
+        from generativeaiexamples_tpu.ops.ivf import kmeans_fit
+
+        data = _clustered(1024, n_clusters=8, sigma=0.05)
+        _, a = kmeans_fit(data, 8, iters=10)
+        # rows from the same tight cluster should mostly co-locate
+        first = a[:128]  # rows are center-ordered only in expectation;
+        # instead check partition sizes are non-degenerate
+        sizes = np.bincount(a, minlength=8)
+        assert (sizes > 0).sum() >= 6
+
+
+class TestIVFRecall:
+    def test_recall_at_default_nprobe(self):
+        vecs = _clustered(4096)
+        flat = TPUVectorStore(DIM)
+        _fill(flat, vecs)
+        ivf = _ivf_store(vecs)  # config defaults: nlist=64, nprobe=16
+        queries = _clustered(50, seed=SEED + 1)
+        assert _recall(ivf, flat, queries) >= 0.9
+        st = ivf.stats()
+        assert st["index"] == "ivf"
+        assert st["ann_probes"] > 0 and st["ann_scanned_rows"] > 0
+        # probed refine scans a fraction of the corpus, not all of it
+        assert st["ann_scanned_rows"] < st["searches"] * len(vecs)
+
+    def test_int8_quantized_recall(self):
+        vecs = _clustered(4096, sigma=0.25)
+        flat = TPUVectorStore(DIM)
+        _fill(flat, vecs)
+        ivf8 = _ivf_store(vecs, quantize_int8=True)
+        queries = _clustered(50, seed=SEED + 2)
+        assert _recall(ivf8, flat, queries) >= 0.8
+        assert ivf8.stats()["quantize_int8"] is True
+
+    def test_small_corpus_stays_exact(self):
+        vecs = _clustered(vs_mod.IVF_MIN_ROWS - 10)
+        flat = TPUVectorStore(DIM)
+        _fill(flat, vecs)
+        ivf = _ivf_store(vecs)
+        q = _clustered(5, seed=SEED + 3)
+        for qi in q:
+            a = [(r.text, round(r.score, 6)) for r in flat.search(qi, top_k=4)]
+            b = [(r.text, round(r.score, 6)) for r in ivf.search(qi, top_k=4)]
+            assert a == b  # brute-force path, bit-for-bit ordering
+        assert ivf.stats()["index"] == "flat(ivf pending)"
+
+
+class TestIVFLifecycle:
+    def test_add_after_train_assigns_without_rebuild(self):
+        vecs = _clustered(2048)
+        store = _ivf_store(vecs)
+        store.search(vecs[0], top_k=1)  # trains
+        assert store.stats()["index"] == "ivf"
+        extra = _clustered(64, seed=SEED + 4)
+        store.add([f"new-{i}" for i in range(len(extra))], extra,
+                  [{"filename": "new.txt"} for _ in extra])
+        res = store.search(extra[0], top_k=4)
+        assert any(r.text.startswith("new-") for r in res)
+        assert store.stats()["index_rebuilds"] == 0  # assigned, not retrained
+
+    def test_growth_triggers_rebuild(self):
+        vecs = _clustered(512)
+        store = _ivf_store(vecs)
+        store.search(vecs[0], top_k=1)  # trains at 512 rows
+        extra = _clustered(400, seed=SEED + 5)  # > 50% growth
+        store.add([f"g-{i}" for i in range(len(extra))], extra)
+        store.search(vecs[0], top_k=1)
+        assert store.stats()["index_rebuilds"] == 1
+
+    def test_delete_triggers_rebuild_and_excludes_rows(self):
+        vecs = _clustered(1024)
+        store = TPUVectorStore(DIM, index_type="ivf")
+        half = len(vecs) // 2
+        store.add([f"keep-{i}" for i in range(half)], vecs[:half],
+                  [{"filename": "keep.txt"} for _ in range(half)])
+        store.add([f"drop-{i}" for i in range(half)], vecs[half:],
+                  [{"filename": "drop.txt"} for _ in range(half)])
+        store.search(vecs[0], top_k=1)  # trains
+        removed = store.delete_documents(["drop.txt"])
+        assert removed == half
+        res = store.search(vecs[-1], top_k=8)
+        assert res and all(r.text.startswith("keep-") for r in res)
+        assert store.stats()["index_rebuilds"] == 1
+
+    def test_hot_partition_add_falls_back_to_rebuild(self):
+        # A same-topic bulk add that would skew one partition past the
+        # table's growth cap must retrain (bounded padding) rather than
+        # widen every partition's block to the hot list's length.
+        vecs = _clustered(1024)
+        store = _ivf_store(vecs)
+        store.search(vecs[0], top_k=1)  # trains
+        hot = vecs[0] + 0.01 * np.random.default_rng(0).standard_normal(
+            (300, DIM)).astype(np.float32)  # all land in one partition
+        hot /= np.linalg.norm(hot, axis=1, keepdims=True)
+        store.add([f"hot-{i}" for i in range(len(hot))], hot)
+        # the overflow-detecting search serves the exact flat fallback
+        res = store.search(hot[0], top_k=4)
+        assert any(r.text.startswith("hot-") for r in res)
+        # ...and the next search rebuilds the clustered index
+        res = store.search(hot[0], top_k=4)
+        assert any(r.text.startswith("hot-") for r in res)
+        st = store.stats()
+        assert st["index"] == "ivf" and st["index_rebuilds"] == 1
+        # post-rebuild table is balanced again, not hot-list wide
+        n = len(store)
+        assert store._ivf.max_list_len <= 4 * max(1, n // store._ivf.nlist)
+
+    def test_recall_estimate_gauge(self, monkeypatch):
+        monkeypatch.setattr(vs_mod, "RECALL_SAMPLE_EVERY", 2)
+        vecs = _clustered(1024)
+        store = _ivf_store(vecs)
+        for q in _clustered(6, seed=SEED + 6):
+            store.search(q, top_k=4)
+        est = store.stats()["ann_recall_est"]
+        assert est is not None and 0.0 <= est <= 1.0
+
+
+class TestSearchBatch:
+    @pytest.mark.parametrize("cls", [MemoryVectorStore, TPUVectorStore])
+    def test_batched_matches_sequential(self, cls):
+        vecs = _clustered(300)
+        store = cls(DIM)
+        _fill(store, vecs)
+        queries = _clustered(8, seed=SEED + 7)
+        seq = [store.search(q, top_k=3) for q in queries]
+        bat = store.search_batch(queries, top_k=3)
+        assert len(bat) == len(queries)
+        for a, b in zip(seq, bat):
+            assert [r.text for r in a] == [r.text for r in b]
+            np.testing.assert_allclose([r.score for r in a],
+                                       [r.score for r in b], atol=1e-5)
+
+    def test_ivf_batch_is_one_dispatch(self):
+        vecs = _clustered(2048)
+        store = _ivf_store(vecs)
+        queries = _clustered(6, seed=SEED + 8)
+        before = store.stats()["batched_searches"]
+        out = store.search_batch(queries, top_k=4)
+        assert len(out) == 6 and all(out)
+        assert store.stats()["batched_searches"] == before + 1
+
+    def test_rejects_1d_queries(self):
+        store = MemoryVectorStore(DIM)
+        with pytest.raises(ValueError):
+            store.search_batch(np.zeros((DIM,), np.float32))
+
+
+class TestShardedIVF:
+    def test_matches_single_device(self, eight_devices):
+        from generativeaiexamples_tpu.ops.ivf import (
+            IVFIndex, ShardedIVFIndex, kmeans_fit)
+        from generativeaiexamples_tpu.parallel.mesh import build_mesh
+        from generativeaiexamples_tpu.config.schema import MeshConfig
+
+        mesh = build_mesh(MeshConfig())
+        vecs = _clustered(2048)
+        c, a = kmeans_fit(vecs, 32)
+        single = IVFIndex(vecs, 32, nprobe=8, centroids=c, assignments=a)
+        sharded = ShardedIVFIndex(vecs, 32, mesh, nprobe=8,
+                                  centroids=c, assignments=a)
+        q = _clustered(5, seed=SEED + 9)
+        s1, i1, sc1 = single.search(q, 4)
+        s2, i2, sc2 = sharded.search(q, 4)
+        # same centroids + assignments -> identical candidate sets
+        for row in range(len(q)):
+            assert set(np.asarray(i1)[row].tolist()) == \
+                set(np.asarray(i2)[row].tolist())
+        np.testing.assert_allclose(np.sort(np.asarray(s1), axis=1),
+                                   np.sort(np.asarray(s2), axis=1),
+                                   atol=1e-5)
+        assert sc1 == sc2
+
+    def test_store_with_mesh_uses_sharded_ivf(self, eight_devices):
+        from generativeaiexamples_tpu.ops.ivf import ShardedIVFIndex
+        from generativeaiexamples_tpu.parallel.mesh import build_mesh
+        from generativeaiexamples_tpu.config.schema import MeshConfig
+
+        mesh = build_mesh(MeshConfig())
+        vecs = _clustered(1024)
+        store = TPUVectorStore(DIM, mesh=mesh, index_type="ivf")
+        _fill(store, vecs)
+        res = store.search(vecs[0], top_k=4)
+        assert res and isinstance(store._ivf, ShardedIVFIndex)
+        # incremental add flows through the sharded layout too
+        extra = _clustered(32, seed=SEED + 10)
+        store.add([f"s-{i}" for i in range(len(extra))], extra)
+        res = store.search(extra[0], top_k=4)
+        assert any(r.text.startswith("s-") for r in res)
+
+
+class TestPersistence:
+    def test_ivf_save_load_roundtrip_skips_training(self, tmp_path,
+                                                    monkeypatch):
+        vecs = _clustered(1024)
+        d = str(tmp_path)
+        store = TPUVectorStore(DIM, persist_dir=d, index_type="ivf")
+        _fill(store, vecs)
+        q = _clustered(4, seed=SEED + 11)
+        first = [[r.text for r in store.search(qi, top_k=4)] for qi in q]
+        assert os.path.isfile(os.path.join(d, "ivf.npz"))
+
+        from generativeaiexamples_tpu.ops import ivf as ivf_ops
+
+        def boom(*a, **k):
+            raise AssertionError("reload must not retrain k-means")
+
+        monkeypatch.setattr(ivf_ops, "kmeans_fit", boom)
+        store2 = TPUVectorStore(DIM, persist_dir=d, index_type="ivf")
+        assert len(store2) == len(store)
+        again = [[r.text for r in store2.search(qi, top_k=4)] for qi in q]
+        assert again == first
+
+    def test_sidecar_rewritten_after_incremental_add(self, tmp_path):
+        vecs = _clustered(512)
+        d = str(tmp_path)
+        store = TPUVectorStore(DIM, persist_dir=d, index_type="ivf")
+        _fill(store, vecs)
+        store.search(vecs[0], top_k=1)  # trains, writes sidecar
+        # add: the mutation-time save removes the now-lagging sidecar...
+        store.add(["late"], _clustered(1, seed=SEED + 12))
+        assert not os.path.isfile(os.path.join(d, "ivf.npz"))
+        # ...and the incremental sync at next search restores it
+        store.search(vecs[0], top_k=1)
+        assert os.path.isfile(os.path.join(d, "ivf.npz"))
+
+    def test_noop_delete_keeps_index(self):
+        vecs = _clustered(512)
+        store = _ivf_store(vecs)
+        store.search(vecs[0], top_k=1)  # trains
+        assert store.delete_documents(["not-there.txt"]) == 0
+        store.search(vecs[0], top_k=1)
+        assert store.stats()["index_rebuilds"] == 0
+
+    def test_stale_ivf_sidecar_is_ignored(self, tmp_path):
+        vecs = _clustered(512)
+        d = str(tmp_path)
+        store = TPUVectorStore(DIM, persist_dir=d, index_type="ivf")
+        _fill(store, vecs)
+        store.search(vecs[0], top_k=1)
+        # corrupt the sidecar to a wrong row count: loader must retrain
+        np.savez_compressed(os.path.join(d, "ivf.npz"),
+                            centroids=np.zeros((4, DIM), np.float32),
+                            assignments=np.zeros((3,), np.int32))
+        store2 = TPUVectorStore(DIM, persist_dir=d, index_type="ivf")
+        assert store2.search(vecs[0], top_k=2)  # retrained fine
+
+    def test_save_is_atomic_under_midwrite_crash(self, tmp_path,
+                                                 monkeypatch):
+        store = MemoryVectorStore(DIM)
+        _fill(store, _clustered(64))
+        d = str(tmp_path)
+        store.save(d)
+        n0 = len(store)
+        store._docs.append({"text": "extra", "metadata": {}})
+        store._vecs = np.concatenate(
+            [store._vecs, np.zeros((1, DIM), np.float32)])
+
+        calls = {"n": 0}
+        real_dumps = json.dumps
+
+        def flaky(obj, *a, **k):
+            calls["n"] += 1
+            if calls["n"] > 3:
+                raise OSError("disk gone mid-write")
+            return real_dumps(obj, *a, **k)
+
+        monkeypatch.setattr(vs_mod.json, "dumps", flaky)
+        with pytest.raises(OSError):
+            store.save(d)
+        monkeypatch.undo()
+        # previous snapshot intact, no temp debris
+        loaded = MemoryVectorStore.load(d, DIM)
+        assert len(loaded) == n0
+        assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+class TestRetrieverBatching:
+    def _retriever(self, store=None, **kw):
+        from generativeaiexamples_tpu.connectors.fakes import HashEmbedder
+        from generativeaiexamples_tpu.rag.retriever import Retriever
+
+        emb = HashEmbedder(dim=64)
+        if store is None:
+            store = MemoryVectorStore(64)
+            texts = ["TPUs multiply matrices fast", "bananas are yellow",
+                     "HBM is high bandwidth memory", "apples can be green"]
+            store.add(texts, emb.embed_documents(texts),
+                      [{"filename": "t.txt"} for _ in texts])
+        return Retriever(store, emb, top_k=2, **kw)
+
+    def test_retrieve_batch_aligns_and_falls_back(self):
+        r = self._retriever(score_threshold=0.99)
+        out = r.retrieve_batch(["TPU matrices", "zzz nonsense query"])
+        assert len(out) == 2
+        assert out[0] and out[1]  # both non-empty via threshold fallback
+
+    def test_retrieve_multi_single_dispatch(self):
+        r = self._retriever(score_threshold=None)
+        store = r.store
+        before = store.stats()["batched_searches"]
+        hits = r.retrieve_multi(["TPU matrix hardware", "HBM bandwidth",
+                                 "memory speed"])
+        assert hits
+        assert store.stats()["batched_searches"] == before + 1
+
+    def test_hybrid_extra_queries_batched(self):
+        from generativeaiexamples_tpu.connectors.fakes import OverlapReranker
+
+        r = self._retriever(reranker=OverlapReranker())
+        store = r.store
+        before = store.stats()["batched_searches"]
+        hits = r.retrieve_hybrid("TPU matrices",
+                                 extra_queries=["HBM memory bandwidth"])
+        assert hits
+        assert store.stats()["batched_searches"] == before + 1
+
+
+class TestMetricsSurface:
+    def test_chain_server_metrics_exposes_store_stats(self, tmp_path):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from generativeaiexamples_tpu.api.server import ChainServer
+        from generativeaiexamples_tpu.config.wizard import load_config
+        from generativeaiexamples_tpu.connectors.fakes import (
+            EchoLLM, HashEmbedder)
+        from generativeaiexamples_tpu.pipelines.base import get_example_class
+        from generativeaiexamples_tpu.pipelines.resources import Resources
+
+        cfg = load_config(None)
+        res = Resources(cfg, llm=EchoLLM(), embedder=HashEmbedder(64))
+        ex = get_example_class("developer_rag")(res)
+        server = ChainServer(cfg, example=ex,
+                             upload_dir=str(tmp_path / "up"))
+
+        async def run():
+            client = TestClient(TestServer(server.app))
+            await client.start_server()
+            try:
+                resp = await client.get("/metrics")
+                assert resp.status == 200
+                body = await resp.json()
+                assert "vector_store" in body
+                st = body["vector_store"]
+                for key in ("index", "ntotal", "searches", "ann_probes",
+                            "ann_scanned_rows", "ann_recall_est",
+                            "index_rebuilds"):
+                    assert key in st
+            finally:
+                await client.close()
+
+        asyncio.new_event_loop().run_until_complete(run())

@@ -45,7 +45,7 @@ def test_shard_pytree_places_on_mesh(eight_devices):
 
 def test_matmul_with_psum_over_tensor(eight_devices):
     """A hand-rolled TP matmul: contract over the sharded dim with psum."""
-    from jax import shard_map
+    from generativeaiexamples_tpu.ops.topk import shard_map_compat
 
     m = mesh_lib.build_mesh(MeshConfig())
     x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
@@ -54,8 +54,8 @@ def test_matmul_with_psum_over_tensor(eight_devices):
     def local(x, w):
         return jax.lax.psum(x @ w, "tensor")
 
-    fn = shard_map(
+    fn = shard_map_compat(
         local, mesh=m, in_specs=(P(None, "tensor"), P("tensor", None)),
-        out_specs=P(), check_vma=False,
+        out_specs=P(),
     )
     np.testing.assert_allclose(fn(x, w), x @ w, rtol=1e-5)
