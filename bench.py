@@ -15,7 +15,9 @@ Env knobs: BENCH_MODEL (8b|1b|tiny), BENCH_BATCH, BENCH_PROMPT,
 BENCH_GEN, BENCH_PAGE, BENCH_QUANT (0|1), BENCH_KV_DTYPE, BENCH_SPEC,
 BENCH_K, BENCH_PIPELINE, BENCH_DEVICE_INIT, BENCH_LONGCTX (0 skips),
 BENCH_PREFIX (0 skips), BENCH_ENCODERS (0 skips), BENCH_ANN (0 skips;
-BENCH_ANN_N / _DIM / _NLIST / _NPROBE tune the corpus and index).
+BENCH_ANN_N / _DIM / _NLIST / _NPROBE tune the corpus and index),
+BENCH_CONCURRENT (0 skips; BENCH_CONCURRENT_THREADS / _REQS / _N
+tune caller count, requests per caller, corpus size).
 
 Scenario output keys (under "extras"):
   long-context:  ttft_prompt2k_ms, ttft_prompt8k_ms,
@@ -34,6 +36,13 @@ Scenario output keys (under "extras"):
                  TPUVectorStore at BENCH_ANN_N=100k synthetic clustered
                  vectors — the ops/ivf.py two-stage index;
                  BENCH_ANN=0 skips)
+  concurrent:    concurrent_rag_qps, microbatch_occupancy,
+                 embed_p99_wait_ms, serialized_rag_qps,
+                 microbatch_vs_serial_speedup, microbatch_dispatches_saved
+                 (16 concurrent embed+search RAG front-halves through
+                 the serving/batcher.py cross-request micro-batcher vs
+                 the same load with the batcher off — the Triton
+                 dynamic-batcher role; BENCH_CONCURRENT=0 skips)
 
 `python bench.py --help` prints this header and exits.
 """
@@ -334,6 +343,20 @@ def main() -> None:
         except Exception as e:
             ann_stats = {"ann_error": f"{type(e).__name__}: {e}"}
 
+    # -- concurrent RAG front half: cross-request micro-batching
+    # (ISSUE 3 tentpole — N concurrent embed+search callers must share
+    # device dispatches instead of serializing batch-of-1 launches).
+    concurrent_stats = {}
+    if os.environ.get("BENCH_CONCURRENT", "1") != "0":
+        import gc
+
+        gc.collect()
+        try:
+            concurrent_stats = _bench_concurrent()
+        except Exception as e:
+            concurrent_stats = {"concurrent_error":
+                                f"{type(e).__name__}: {e}"}
+
     tps = total_tokens / wall
     out = {
         "metric": f"decode_tokens_per_sec_per_chip_llama3_{model}"
@@ -365,6 +388,7 @@ def main() -> None:
             **prefix_stats,
             **encoder_stats,
             **ann_stats,
+            **concurrent_stats,
         },
     }
     print(json.dumps(out))
@@ -603,6 +627,101 @@ def _bench_ann():
         del ivf
         gc.collect()
     return stats
+
+
+def _bench_concurrent():
+    """Concurrent RAG front half (embed_query -> vector search) with the
+    cross-request micro-batcher ON vs the serialize-per-caller baseline:
+    N threads, each issuing sequential requests — the chain-server
+    concurrency shape. Occupancy is the mean coalesced batch size over
+    embed dispatches; wait is what coalescing costs a caller in queue
+    time."""
+    import dataclasses
+    import gc
+    import random as pyrandom
+    import string
+    import threading
+
+    from generativeaiexamples_tpu.models import bert
+    from generativeaiexamples_tpu.rag.vectorstore import TPUVectorStore
+    from generativeaiexamples_tpu.serving.encoders import EmbeddingEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    n_threads = int(os.environ.get("BENCH_CONCURRENT_THREADS", "16"))
+    reqs_each = int(os.environ.get("BENCH_CONCURRENT_REQS", "8"))
+    n_rows = int(os.environ.get("BENCH_CONCURRENT_N", "20000"))
+    total = n_threads * reqs_each
+
+    # Query-bucket geometry from _bench_encoders; the small encoder keeps
+    # the scenario about dispatch amortization, not encoder FLOPs, so it
+    # also finishes on CPU CI.
+    bcfg = dataclasses.replace(
+        bert.BertConfig.tiny(vocab_size=512), max_position=128)
+    emb = EmbeddingEngine(bert.init_params(bcfg, jax.random.PRNGKey(3)),
+                          bcfg, ByteTokenizer(), max_batch=n_threads,
+                          buckets=(64, 128))
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((n_rows, bcfg.dim)).astype(np.float32)
+    corpus /= np.linalg.norm(corpus, axis=1, keepdims=True)
+    store = TPUVectorStore(bcfg.dim)
+    store.add([f"chunk-{i}" for i in range(n_rows)], corpus)
+
+    pyr = pyrandom.Random(0)
+    queries = ["".join(pyr.choice(string.ascii_lowercase + "  ")
+                       for _ in range(48)) for _ in range(total)]
+    emb.embed_query(queries[0])          # warm the jit variants
+    store.search(np.zeros(bcfg.dim, np.float32), top_k=4)
+
+    def drive():
+        """All threads run the front half to completion; returns wall."""
+        barrier = threading.Barrier(n_threads)
+
+        def worker(t):
+            barrier.wait()
+            for r in range(reqs_each):
+                q = queries[t * reqs_each + r]
+                store.search(emb.embed_query(q), top_k=4)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return time.perf_counter() - t0
+
+    serial_wall = drive()  # batcher off: per-caller dispatches
+
+    emb.enable_microbatch(max_batch=n_threads, max_wait_us=2000)
+    store.enable_microbatch(max_batch=n_threads, max_wait_us=2000)
+    # Untimed warm pass: coalesced groups pad to power-of-two batch
+    # shapes the Q=1 warmup above never compiled; without this the
+    # timed region eats the XLA compiles and under-reports the speedup.
+    drive()
+    # Fresh batchers -> fresh counters for the measured window.
+    emb.enable_microbatch(max_batch=n_threads, max_wait_us=2000)
+    store.enable_microbatch(max_batch=n_threads, max_wait_us=2000)
+    batched_wall = drive()
+    esnap = emb.microbatch_stats()
+    ssnap = store.microbatch_stats()
+    emb.disable_microbatch()
+    store.disable_microbatch()
+    del emb, store
+    gc.collect()
+
+    return {
+        "concurrent_rag_qps": round(total / batched_wall, 1),
+        "serialized_rag_qps": round(total / serial_wall, 1),
+        "microbatch_vs_serial_speedup": round(
+            serial_wall / batched_wall, 2),
+        "microbatch_occupancy": esnap["mean_batch_size"],
+        "embed_p99_wait_ms": esnap["queue_wait_p99_ms"],
+        "microbatch_dispatches_saved": (esnap["dispatches_saved"]
+                                        + ssnap["dispatches_saved"]),
+        "concurrent_threads": n_threads,
+        "concurrent_requests": total,
+    }
 
 
 def _bench_encoders():

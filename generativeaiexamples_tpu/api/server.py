@@ -78,7 +78,14 @@ class ChainServer:
             self.example = get_example_class(name)(resources)
         self.upload_dir = upload_dir
         os.makedirs(upload_dir, exist_ok=True)
-        self._executor = ThreadPoolExecutor(max_workers=64,
+        # Executor width bounds request concurrency. With micro-batching
+        # on it is floored above the batch window — otherwise the
+        # batcher can never see a full window's worth of concurrent
+        # callers; with it off, the operator's setting stands alone.
+        workers = config.serving.executor_workers
+        if config.serving.microbatch_enabled:
+            workers = max(workers, 2 * config.serving.microbatch_max_batch)
+        self._executor = ThreadPoolExecutor(max_workers=workers,
                                             thread_name_prefix="chain-srv")
         self.app = web.Application(client_max_size=100 * 1024 * 1024)
         self.app.add_routes([
@@ -107,9 +114,11 @@ class ChainServer:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Retrieval-side observability: the vector stores' counters
         (searches, ann_probes / ann_scanned_rows / ann_recall_est /
-        index_rebuilds when the IVF index is live). The serving
-        engine's token metrics live on ITS /metrics
-        (serving/openai_server.py)."""
+        index_rebuilds when the IVF index is live) plus the
+        cross-request micro-batcher counters per stage (embed / rerank /
+        search: mean coalesced batch size, queue-wait p50/p99,
+        dispatches saved — serving/batcher.py). The serving engine's
+        token metrics live on ITS /metrics (serving/openai_server.py)."""
         payload: Dict[str, Any] = {}
         res = getattr(self.example, "res", None)
         for key in ("store", "conv_store"):
@@ -117,6 +126,9 @@ class ChainServer:
             if store is not None and hasattr(store, "stats"):
                 payload[f"vector_{key}" if key == "store" else key] = \
                     store.stats()
+        retriever = getattr(res, "retriever", None)
+        if retriever is not None and hasattr(retriever, "microbatch_stats"):
+            payload["microbatch"] = retriever.microbatch_stats()
         return web.json_response(payload)
 
     # -- /generate ---------------------------------------------------------
@@ -135,11 +147,14 @@ class ChainServer:
             role = sanitize(str(m.get("role", "user")))
             content = sanitize(str(m.get("content", "")))
             chat_history.append({"role": role, "content": content})
-        # last user message is the query (reference server.py:261-267)
-        for m in reversed(chat_history):
-            if m["role"] == "user":
-                query = m["content"]
-                chat_history.remove(m)
+        # last user message is the query (reference server.py:261-267).
+        # Remove by INDEX: list.remove() matches by value, so a user
+        # message duplicated earlier in the history would be deleted in
+        # the query's place.
+        for i in range(len(chat_history) - 1, -1, -1):
+            if chat_history[i]["role"] == "user":
+                query = chat_history[i]["content"]
+                del chat_history[i]
                 break
         use_kb = bool(body.get("use_knowledge_base", False))
         llm_settings = {

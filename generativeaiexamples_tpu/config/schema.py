@@ -254,6 +254,33 @@ class EngineConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Chain-server request-path knobs: cross-request dynamic
+    micro-batching for the RAG pre-generation stages (embed / rerank /
+    ANN search — serving/batcher.py, the Triton dynamic-batcher role the
+    reference delegates to NIM microservices), and the executor width
+    that bounds how many requests can be in flight at once."""
+
+    # Coalesce concurrent embed / rerank / vector-search callers into
+    # one device dispatch. Off by default — off is byte-identical to
+    # the serialize-per-request behavior.
+    microbatch_enabled: bool = False
+    # Most requests one dispatch may absorb. Keep <= the encoder
+    # engines' max_batch so a coalesced group still fits one forward.
+    microbatch_max_batch: int = 16
+    # How long the first queued request waits for company before the
+    # dispatch launches anyway. Under load the window never adds
+    # latency (the device is busy; arrivals pile up behind the running
+    # dispatch); idle single requests pay at most this once.
+    microbatch_max_wait_us: int = 2000
+    # ThreadPoolExecutor width for the chain server's blocking chain /
+    # ingest / search work. Must comfortably exceed
+    # microbatch_max_batch, or concurrency caps below the batch window
+    # and coalescing can never fill a dispatch.
+    executor_workers: int = 64
+
+
+@dataclass(frozen=True)
 class TracingConfig:
     """OTel export settings (parity: common/tracing.py, ENABLE_TRACING)."""
 
@@ -277,6 +304,7 @@ class AppConfig:
     prompts: PromptsConfig = field(default_factory=PromptsConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
 
 

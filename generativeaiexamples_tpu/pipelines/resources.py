@@ -29,6 +29,22 @@ class Resources:
         self.reranker = (reranker if reranker is not None
                          else factory.get_reranker(config))
         dim = getattr(self.embedder, "dim", config.embeddings.dimensions)
+        # Cross-request dynamic micro-batching (serving.microbatch):
+        # concurrent request threads' embed / rerank / search calls
+        # coalesce into one device dispatch each (serving/batcher.py).
+        # Applied here so every pipeline and the chain server share the
+        # same batched stages; injected fakes get the connector-level
+        # wrapper, in-process engines batch at the bucketed forward.
+        sv = config.serving
+        if sv.microbatch_enabled:
+            from generativeaiexamples_tpu.serving import batcher as mb
+
+            self.embedder = mb.enable_embedder_microbatch(
+                self.embedder, max_batch=sv.microbatch_max_batch,
+                max_wait_us=sv.microbatch_max_wait_us)
+            self.reranker = mb.enable_reranker_microbatch(
+                self.reranker, max_batch=sv.microbatch_max_batch,
+                max_wait_us=sv.microbatch_max_wait_us)
         # The document store is durable when persist_dir is configured
         # (loads existing data now, saves on every mutation); the
         # conversation-memory store is always ephemeral.
@@ -40,6 +56,13 @@ class Resources:
         # even when the document store is an external DB.
         self.conv_store = conv_store if conv_store is not None else \
             create_vector_store(config, dim=dim, mesh=mesh, ephemeral=True)
+        if sv.microbatch_enabled and hasattr(self.store,
+                                             "enable_microbatch"):
+            # Document store only: conversation memory is per-request
+            # scratch far below coalescing scale.
+            self.store.enable_microbatch(
+                max_batch=sv.microbatch_max_batch,
+                max_wait_us=sv.microbatch_max_wait_us)
         self.splitter = get_text_splitter(config)
         self.retriever = Retriever(
             self.store, self.embedder,

@@ -8,6 +8,12 @@ Combines the reference's retrieval behaviors in one place:
   budget, whole-chunk granularity (common/utils.py:100-122, 1500 cap).
 - `ranked_hybrid` parity (fm-asr retriever.py:64-110): dense + lexical
   candidate union, cross-encoder rerank, stdev outlier dropping.
+
+Under `serving.microbatch` (serving/batcher.py) the three device-bound
+stages this class drives — embed_query, reranker.score, store.search —
+each coalesce across concurrent request threads into one dispatch;
+`microbatch_stats()` aggregates the per-stage batcher counters for the
+chain server's /metrics.
 """
 
 from __future__ import annotations
@@ -214,6 +220,25 @@ class Retriever:
             keep = vals >= vals.mean() - vals.std()
             cands = [c for c, kp in zip(cands, keep) if kp]
         return cands
+
+    # -- observability -----------------------------------------------------
+
+    def microbatch_stats(self) -> dict:
+        """Cross-request batcher counters for the stages this retriever
+        drives, keyed by stage ("embed" / "rerank" / "search"). Stages
+        without a live batcher (wiring off, external store, fake
+        reranker) are omitted; empty dict = micro-batching off."""
+        from generativeaiexamples_tpu.serving.batcher import (
+            microbatch_stats_of)
+
+        out = {}
+        for name, obj in (("embed", self.embedder),
+                          ("rerank", self.reranker),
+                          ("search", self.store)):
+            snap = microbatch_stats_of(obj)
+            if snap is not None:
+                out[name] = snap
+        return out
 
     # -- context assembly --------------------------------------------------
 

@@ -42,6 +42,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from generativeaiexamples_tpu.serving.batcher import (
+    MicroBatcher, MicroBatcherClosed, MicroBatchHost)
+
 
 @dataclass
 class SearchResult:
@@ -74,7 +77,7 @@ def _atomic_replace(path: str, write_fn) -> None:
             os.unlink(tmp)
 
 
-class MemoryVectorStore:
+class MemoryVectorStore(MicroBatchHost):
     """Exact cosine/IP search over an [N, D] matrix. Thread-safe.
 
     With `persist_dir` set, the store is durable: existing data is
@@ -111,6 +114,46 @@ class MemoryVectorStore:
             self._persist()
             return list(range(base, base + len(texts)))
 
+    # -- cross-request micro-batching (serving/batcher.py) -----------------
+
+    def _build_microbatcher(self, max_batch, max_wait_us) -> MicroBatcher:
+        """enable_microbatch() funnels concurrent single-query search()
+        callers through the one-dispatch search_batch path: N callers
+        inside the window pay one GEMM (flat) / one probe+refine (IVF)
+        instead of N. Grouped by (top_k, score_threshold) so merged
+        requests are exactly expressible as one batch call."""
+        return MicroBatcher(
+            f"search[{type(self).__name__}]", self._search_group,
+            max_batch=max_batch or 16, max_wait_us=max_wait_us,
+            bucket_fn=lambda item: (item[1], item[2]))
+
+    def _search_group(self, items) -> List[List[SearchResult]]:
+        """Batcher dispatch: items are (query [D], top_k, threshold)
+        sharing one (top_k, threshold) bucket. A lone caller takes the
+        plain single-query path so an idle server stays on today's
+        exact code path. Device-backed stores pad the group to a batch
+        ladder (`_group_pad`) so the jitted search compiles one program
+        per rung, not one per distinct group size."""
+        top_k, thr = items[0][1], items[0][2]
+        if len(items) == 1:
+            return [self._search_one(items[0][0], top_k, thr,
+                                     defer_async=True)]
+        qs = np.stack([np.asarray(it[0], np.float32) for it in items])
+        n = len(qs)
+        padded = self._group_pad(n)
+        if padded != n:
+            # Repeat the last real query: a well-formed row, results
+            # sliced off below; n_valid keeps the counters honest.
+            qs = np.concatenate([qs, np.tile(qs[-1:], (padded - n, 1))])
+        return self._search_batch_direct(qs, top_k, thr, n_valid=n,
+                                         defer_async=True)[:n]
+
+    def _group_pad(self, n: int) -> int:
+        """Batch rows a coalesced group is padded to. The numpy store
+        runs any shape for free; TPUVectorStore rounds up so XLA sees a
+        bounded set of batch shapes."""
+        return n
+
     # -- search ------------------------------------------------------------
 
     def _scores(self, query: np.ndarray) -> np.ndarray:
@@ -124,6 +167,19 @@ class MemoryVectorStore:
 
     def search(self, query_embedding: np.ndarray, top_k: int = 4,
                score_threshold: Optional[float] = None) -> List[SearchResult]:
+        b = self._batcher  # read once: racing disable() must not crash
+        if b is not None:
+            try:
+                return b.submit(
+                    (np.asarray(query_embedding, np.float32), top_k,
+                     score_threshold))
+            except MicroBatcherClosed:
+                pass  # raced a disable/re-enable: serve direct
+        return self._search_one(query_embedding, top_k, score_threshold)
+
+    def _search_one(self, query_embedding: np.ndarray, top_k: int = 4,
+                    score_threshold: Optional[float] = None,
+                    defer_async: bool = False) -> List[SearchResult]:
         with self._lock:
             if not self._docs:
                 return []
@@ -135,20 +191,33 @@ class MemoryVectorStore:
                      score_threshold: Optional[float] = None
                      ) -> List[List[SearchResult]]:
         """Score ALL queries ([Q, D]) in one pass. Result lists align
-        with the query order. A single-row batch delegates to search()
-        so batched and sequential results are identical."""
+        with the query order. A single-row batch delegates to the
+        single-query path so batched and sequential results are
+        identical. Already one dispatch — never re-enters the
+        micro-batcher."""
         qs = np.asarray(query_embeddings, np.float32)
         if qs.ndim != 2:
             raise ValueError(f"query_embeddings must be [Q, D], got "
                              f"{qs.shape}")
+        return self._search_batch_direct(qs, top_k, score_threshold)
+
+    def _search_batch_direct(self, qs: np.ndarray, top_k: int,
+                             score_threshold: Optional[float],
+                             n_valid: Optional[int] = None,
+                             defer_async: bool = False
+                             ) -> List[List[SearchResult]]:
+        """`n_valid` = how many leading rows are real caller queries
+        (the rest are batch-shape padding, excluded from counters);
+        `defer_async` moves post-search slow work off the calling
+        thread (no-op here; see TPUVectorStore)."""
         if len(qs) == 1:
-            return [self.search(qs[0], top_k=top_k,
-                                score_threshold=score_threshold)]
+            return [self._search_one(qs[0], top_k=top_k,
+                                     score_threshold=score_threshold)]
         with self._lock:
             if not self._docs:
                 return [[] for _ in qs]
             self._n_batched += 1
-            self._n_searches += len(qs)
+            self._n_searches += n_valid if n_valid is not None else len(qs)
             # One [Q,D]x[D,N] GEMM (and for cosine ONE corpus
             # normalization) instead of Q matrix-vector passes.
             if self.metric == "cosine":
@@ -289,6 +358,13 @@ class TPUVectorStore(MemoryVectorStore):
     ShardedMIPSIndex and IVF uses ShardedIVFIndex (partitions split
     across the mesh axis)."""
 
+    def _group_pad(self, n: int) -> int:
+        # Coalesced micro-batch groups round up to the next power of
+        # two: the jitted device search compiles per batch shape, and
+        # an unpadded group would trigger a fresh XLA compile for every
+        # distinct caller count.
+        return 1 << (n - 1).bit_length()
+
     def __init__(self, dim: int, metric: str = "ip", mesh=None,
                  shard_axis: str = "tensor",
                  persist_dir: Optional[str] = None, *,
@@ -317,6 +393,18 @@ class TPUVectorStore(MemoryVectorStore):
         self._recall_n = 0
         self._pending_sample = None
         self._pending_sidecar = None
+        # Single-flight state for dispatcher-offloaded slow work
+        # (_flush_slow_work / _kick_training_async): one worker at a
+        # time, samples dropped when busy, latest sidecar latched.
+        self._slow_lock = threading.Lock()
+        self._slow_busy = False
+        self._slow_next_sidecar = None
+        self._train_busy = False
+        # Serializes every ivf.npz write/unlink: the atomic-replace tmp
+        # name is fixed, so concurrent writers (slow worker / trainer /
+        # inline request threads / save()) would clobber each other's
+        # in-flight tmp file.
+        self._sidecar_lock = threading.Lock()
         # Per-store sampling cadence (bench raises it so the gauge's
         # exact reference scan stays out of timed windows).
         self.recall_sample_every = RECALL_SAMPLE_EVERY
@@ -444,6 +532,33 @@ class TPUVectorStore(MemoryVectorStore):
                 and not self._ivf_stale
                 and self._ivf_synced_rows < len(self._vecs))
 
+    def _kick_training_async(self) -> None:
+        """Run _maybe_train_ivf on a background thread (single-flight).
+        Used by the micro-batcher's dispatcher: k-means over a large
+        corpus runs for seconds, and the one dispatcher thread stalling
+        on it would block EVERY queued search in every bucket — the
+        exact 'searches never queue behind training' invariant the
+        off-lock trainer exists for. Until the install lands, searches
+        serve the exact/stale fallback (always correct)."""
+        with self._lock:
+            needed = self._ivf_needs_train() or self._ivf_wants_relayout()
+        if not needed:
+            return
+        with self._slow_lock:
+            if self._train_busy:
+                return
+            self._train_busy = True
+
+        def run():
+            try:
+                self._maybe_train_ivf()
+            finally:
+                with self._slow_lock:
+                    self._train_busy = False
+
+        threading.Thread(target=run, name="vectorstore-ivf-train",
+                         daemon=True).start()
+
     def _maybe_train_ivf(self) -> None:
         """Train/rebuild/re-layout the IVF index WITHOUT holding the
         store lock: k-means (or the sharded layout re-ship) over a
@@ -540,16 +655,20 @@ class TPUVectorStore(MemoryVectorStore):
 
     # -- search ------------------------------------------------------------
 
-    def _device_search(self, qs: np.ndarray, k: int):
+    def _device_search(self, qs: np.ndarray, k: int,
+                       n_valid: Optional[int] = None):
         """One device dispatch for [Q, D] queries -> (scores [Q,k],
-        ids [Q,k]) host arrays; updates the ANN counters. Every
-        RECALL_SAMPLE_EVERYth query queues a recall sample the caller
-        runs AFTER releasing the lock (the exact reference scan is
-        O(N*D) on the host and must not block concurrent searches)."""
+        ids [Q,k]) host arrays; updates the ANN counters (`n_valid`
+        caps them at the real caller queries when the batch carries
+        shape padding). Every RECALL_SAMPLE_EVERYth query queues a
+        recall sample the caller runs AFTER releasing the lock (the
+        exact reference scan is O(N*D) on the host and must not block
+        concurrent searches)."""
+        nv = n_valid if n_valid is not None else len(qs)
         if self._ivf is not None:
             scores, idx, scanned = self._ivf.search(qs, k)
-            self._ann_probes += len(qs) * self._ivf.nprobe
-            self._ann_scanned += scanned
+            self._ann_probes += nv * self._ivf.nprobe
+            self._ann_scanned += scanned * nv // max(1, len(qs))
             if self._n_searches % self.recall_sample_every == 0:
                 # _vecs is replaced on mutation, never written in place,
                 # so the snapshot reference is safe to scan lock-free.
@@ -575,18 +694,20 @@ class TPUVectorStore(MemoryVectorStore):
         self._pending_sidecar = None
         return state
 
-    @staticmethod
-    def _dump_ivf_state(path: str, state: Dict) -> None:
-        """The one ivf.npz writer (atomic): both the lock-held save()
-        path and the deferred search-path writer go through it, so the
-        sidecar format cannot fork."""
-        os.makedirs(path, exist_ok=True)
+    def _dump_ivf_state(self, path: str, state: Dict) -> None:
+        """The one ivf.npz writer (atomic, serialized): the lock-held
+        save() path, the deferred search-path writer, and the
+        background trainer all go through it, so the sidecar format
+        cannot fork and concurrent writers cannot clobber each other's
+        fixed-name tmp file."""
+        with self._sidecar_lock:
+            os.makedirs(path, exist_ok=True)
 
-        def write(tmp):
-            with open(tmp, "wb") as fh:
-                np.savez_compressed(fh, **state)
+            def write(tmp):
+                with open(tmp, "wb") as fh:
+                    np.savez_compressed(fh, **state)
 
-        _atomic_replace(os.path.join(path, "ivf.npz"), write)
+            _atomic_replace(os.path.join(path, "ivf.npz"), write)
 
     def _write_sidecar(self, state: Dict) -> None:
         """Persist IVF training state (no lock needed: `state` is a
@@ -636,9 +757,16 @@ class TPUVectorStore(MemoryVectorStore):
             out.append(SearchResult(d["text"], float(s), dict(d["metadata"])))
         return out
 
-    def search(self, query_embedding: np.ndarray, top_k: int = 4,
-               score_threshold: Optional[float] = None) -> List[SearchResult]:
-        self._maybe_train_ivf()  # slow k-means runs before we lock
+    def _search_one(self, query_embedding: np.ndarray, top_k: int = 4,
+                    score_threshold: Optional[float] = None,
+                    defer_async: bool = False) -> List[SearchResult]:
+        # Slow k-means runs before we lock; from the micro-batcher's
+        # dispatcher it is kicked to a background thread instead —
+        # queued searches serve the exact/stale fallback meanwhile.
+        if defer_async:
+            self._kick_training_async()
+        else:
+            self._maybe_train_ivf()
         with self._lock:
             if not self._docs:
                 return []
@@ -650,40 +778,86 @@ class TPUVectorStore(MemoryVectorStore):
             out = self._collect(scores[0], idx[0], score_threshold)
             sample = self._pop_pending_sample()
             sidecar = self._pop_pending_sidecar()
-        if sidecar is not None:
-            self._write_sidecar(sidecar)
-        if sample:
-            self._run_recall_sample(*sample)
+        self._flush_slow_work(sample, sidecar, asynchronously=defer_async)
         return out
 
-    def search_batch(self, query_embeddings: np.ndarray, top_k: int = 4,
-                     score_threshold: Optional[float] = None
-                     ) -> List[List[SearchResult]]:
+    def _search_batch_direct(self, qs: np.ndarray, top_k: int,
+                             score_threshold: Optional[float],
+                             n_valid: Optional[int] = None,
+                             defer_async: bool = False
+                             ) -> List[List[SearchResult]]:
         """All queries scored in ONE device dispatch (one matmul for
-        flat, one probe+refine for IVF) instead of one per query."""
-        qs = np.asarray(query_embeddings, np.float32)
-        if qs.ndim != 2:
-            raise ValueError(f"query_embeddings must be [Q, D], got "
-                             f"{qs.shape}")
-        self._maybe_train_ivf()  # slow k-means runs before we lock
+        flat, one probe+refine for IVF) instead of one per query.
+        `n_valid`/`defer_async`: see MemoryVectorStore."""
+        # See _search_one: training never runs on the dispatcher thread.
+        if defer_async:
+            self._kick_training_async()
+        else:
+            self._maybe_train_ivf()
         with self._lock:
             if not self._docs:
                 return [[] for _ in qs]
             self._refresh()
             self._n_batched += 1
-            self._n_searches += len(qs)
+            self._n_searches += n_valid if n_valid is not None else len(qs)
             qs = self._prep_query(qs)
             k = min(top_k, len(self._docs))
-            scores, idx = self._device_search(qs, k)
+            scores, idx = self._device_search(qs, k, n_valid=n_valid)
             out = [self._collect(s, i, score_threshold)
                    for s, i in zip(scores, idx)]
             sample = self._pop_pending_sample()
             sidecar = self._pop_pending_sidecar()
+        self._flush_slow_work(sample, sidecar, asynchronously=defer_async)
+        return out
+
+    def _flush_slow_work(self, sample, sidecar, *,
+                         asynchronously: bool = False) -> None:
+        """Post-search slow work: the recall sample's exact host scan
+        (O(N*D)) and the compressed ivf.npz sidecar write. Inline on a
+        caller thread (the pre-batcher behavior), but handed to a
+        SINGLE-FLIGHT worker when invoked from the micro-batcher's
+        dispatcher — that thread must keep draining coalesced searches,
+        not stall every queued caller behind a reference scan, and
+        scans must not pile up thread-per-dispatch under load: while a
+        worker runs, new samples are dropped (a sampled gauge loses
+        nothing) and the newest sidecar is latched for the worker to
+        write before exiting."""
+        if sample is None and sidecar is None:
+            return
+        if asynchronously:
+            with self._slow_lock:
+                if self._slow_busy:
+                    if sidecar is not None:
+                        self._slow_next_sidecar = sidecar  # latest wins
+                    return
+                self._slow_busy = True
+            threading.Thread(
+                target=self._slow_worker, args=(sample, sidecar),
+                name="vectorstore-slow-work", daemon=True).start()
+            return
         if sidecar is not None:
             self._write_sidecar(sidecar)
         if sample:
             self._run_recall_sample(*sample)
-        return out
+
+    def _slow_worker(self, sample, sidecar) -> None:
+        try:
+            while True:
+                self._flush_slow_work(sample, sidecar)
+                with self._slow_lock:
+                    sidecar = self._slow_next_sidecar
+                    self._slow_next_sidecar = None
+                    if sidecar is None:
+                        self._slow_busy = False
+                        return
+                sample = None  # only the latched sidecar remains
+        except BaseException:
+            with self._slow_lock:
+                self._slow_busy = False
+                # Drop the latch too: keeping it would let a future
+                # worker write this now-stale sidecar over a newer one.
+                self._slow_next_sidecar = None
+            raise
 
     # -- observability -----------------------------------------------------
 
@@ -714,8 +888,9 @@ class TPUVectorStore(MemoryVectorStore):
         when the index lags the corpus — the loader would mis-assign."""
         ip = os.path.join(path, "ivf.npz")
         if self._ivf is None or self._ivf_synced_rows != len(self._vecs):
-            if os.path.exists(ip):
-                os.unlink(ip)
+            with self._sidecar_lock:  # vs an in-flight sidecar write
+                if os.path.exists(ip):
+                    os.unlink(ip)
             return
         self._dump_ivf_state(path, self._ivf.state())
 

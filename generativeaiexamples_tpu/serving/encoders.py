@@ -5,6 +5,12 @@ reranking `nv-rerank-qa-mistral-4b`; docker-compose-nim-ms.yaml:24-84)
 reached over HTTP. Here both are in-process JAX engines over the
 models.bert encoder, with bucketed padding so each (batch, seq) shape
 compiles once.
+
+Both engines support cross-request dynamic micro-batching
+(`enable_microbatch`, serving/batcher.py — the Triton dynamic-batcher
+role): concurrent callers coalesce into one bucketed forward instead of
+queueing batch-of-1 dispatches behind the engine lock. Off by default;
+off is byte-identical to the pre-batcher engines.
 """
 
 from __future__ import annotations
@@ -17,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.models import bert
+from generativeaiexamples_tpu.serving.batcher import (
+    MicroBatcher, MicroBatcherClosed, MicroBatchHost)
 
 
 def _bucket(n: int, buckets: Sequence[int]) -> int:
@@ -43,7 +51,7 @@ def _wrap(ids, cls_id, sep_id, limit):
     return ids
 
 
-class EmbeddingEngine:
+class EmbeddingEngine(MicroBatchHost):
     """Batched text -> normalized vector encoder (arctic-embed recipe:
     CLS pooling + L2 norm; query/document prefixes supported)."""
 
@@ -70,6 +78,30 @@ class EmbeddingEngine:
     def dim(self) -> int:
         return self.cfg.dim
 
+    def _build_microbatcher(self, max_batch, max_wait_us) -> MicroBatcher:
+        """enable_microbatch() coalesces concurrent embed()/
+        embed_query() CALLS — one queue item per call, so the stats
+        read in caller units and dispatches_saved is measured against
+        the real one-forward-per-call baseline. Calls merge only when
+        their LONGEST row shares a `_bucket` rung (a short query is
+        never dragged into a long document's padding), and the
+        dispatcher flattens a group's rows into `_forward_ids`, which
+        re-sorts by length and packs the same bucket ladder."""
+        return MicroBatcher(
+            "embed", self._embed_group,
+            max_batch=max_batch or self.max_batch, max_wait_us=max_wait_us,
+            bucket_fn=lambda ids: _bucket(
+                max((len(r) for r in ids), default=1), self.buckets))
+
+    def _embed_group(self, groups: List[List[List[int]]]) -> List[np.ndarray]:
+        flat = [row for g in groups for row in g]
+        vecs = self._forward_ids(flat)
+        out, pos = [], 0
+        for g in groups:
+            out.append(vecs[pos: pos + len(g)])
+            pos += len(g)
+        return out
+
     def _encode_ids(self, texts: Sequence[str]) -> List[List[int]]:
         limit = self.buckets[-1]
         cls_id, sep_id = _specials(self.tokenizer)
@@ -83,6 +115,25 @@ class EmbeddingEngine:
         if is_query:
             texts = [self.QUERY_PREFIX + t for t in texts]
         ids = self._encode_ids(texts)
+        b = self._batcher  # read once: racing disable() must not crash
+        if b is not None:
+            # The whole call rides the shared cross-request queue as ONE
+            # item; calls whose longest rows share a bucket merge into a
+            # length-sorted pass in the dispatcher. Rows are
+            # batch-independent in the forward, so same-bucket
+            # single-row calls (the coalescing case) match the direct
+            # path bitwise; merging can re-chunk a mixed-length
+            # multi-row call, which is the same masked computation at a
+            # different padding width (float rounding may differ).
+            try:
+                return b.submit(ids)
+            except MicroBatcherClosed:
+                pass  # raced a disable/re-enable: serve direct
+        return self._forward_ids(ids)
+
+    def _forward_ids(self, ids: Sequence[List[int]]) -> np.ndarray:
+        """Token-id rows -> [n, D] embeddings: sort by length, pack into
+        bucketed fixed-shape batches, one forward per chunk."""
         out = np.zeros((len(ids), self.cfg.dim), np.float32)
         order = sorted(range(len(ids)), key=lambda i: len(ids[i]))
         with self._lock:
@@ -118,7 +169,7 @@ class EmbeddingEngine:
         return self.embed([text], is_query=True)[0]
 
 
-class RerankEngine:
+class RerankEngine(MicroBatchHost):
     """Cross-encoder (query, passage) -> relevance score, replacing the
     reranking MS used by ranked_hybrid retrieval (fm-asr retriever.py:64)."""
 
@@ -137,6 +188,30 @@ class RerankEngine:
                                              token_types=tt,
                                              use_pallas=use_pallas)[1])
 
+    def _build_microbatcher(self, max_batch, max_wait_us) -> MicroBatcher:
+        """enable_microbatch() coalesces concurrent score() CALLS — one
+        queue item per (query, passages) set, so stats read in caller
+        units — flattening the group's pairs into one cross-encoder
+        pass and splitting scores back per caller. Sets are
+        bucket-keyed by their longest pair (`_forward_pairs` packs in
+        order, unsorted), so a short set never pays a long set's
+        padding."""
+        return MicroBatcher(
+            "rerank", self._score_group,
+            max_batch=max_batch or self.max_batch, max_wait_us=max_wait_us,
+            bucket_fn=lambda pairs: _bucket(
+                max(max(1, len(p[0])) for p in pairs), self.buckets))
+
+    def _score_group(self, groups: List[List[Tuple[List[int], int]]]
+                     ) -> List[np.ndarray]:
+        flat = [pair for g in groups for pair in g]
+        scores = self._forward_pairs(flat)
+        out, pos = [], 0
+        for g in groups:
+            out.append(np.asarray(scores[pos: pos + len(g)], np.float32))
+            pos += len(g)
+        return out
+
     def score(self, query: str, passages: Sequence[str]) -> np.ndarray:
         """[n] passages -> [n] float32 relevance scores (higher=better)."""
         if not len(passages):
@@ -153,6 +228,21 @@ class RerankEngine:
             if sep_id is not None and tail:
                 tail = tail + [sep_id]
             pairs.append((head + tail, len(head)))
+        b = self._batcher  # read once: racing disable() must not crash
+        if b is not None:
+            # The whole (query, passages) set is ONE queue item;
+            # concurrent sets merge into one cross-encoder pass and
+            # split back per caller — see EmbeddingEngine.embed.
+            try:
+                return b.submit(pairs)
+            except MicroBatcherClosed:
+                pass  # raced a disable/re-enable: serve direct
+        return self._forward_pairs(pairs)
+
+    def _forward_pairs(self, pairs: Sequence[Tuple[List[int], int]]
+                       ) -> np.ndarray:
+        """(ids, segment-B start) rows -> [n] scores, one forward per
+        bucketed chunk."""
         out = np.zeros((len(pairs),), np.float32)
         with self._lock:
             # Same dispatch-all-then-drain overlap as EmbeddingEngine.

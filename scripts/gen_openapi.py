@@ -120,11 +120,18 @@ def build_schema() -> dict:
                                    "gauges (ann_probes, "
                                    "ann_scanned_rows, ann_recall_est, "
                                    "index_rebuilds) when the IVF index "
-                                   "is live.",
+                                   "is live; plus the 'microbatch' "
+                                   "section (per-stage cross-request "
+                                   "batcher counters: mean coalesced "
+                                   "batch size, queue-wait p50/p99, "
+                                   "dispatches saved) when "
+                                   "serving.microbatch is enabled.",
                     "operationId": "retrieval_metrics_metrics_get",
                     "responses": {"200": {
                         "description": "per-store stats keyed by store "
-                                       "role (vector_store, conv_store)",
+                                       "role (vector_store, conv_store) "
+                                       "+ 'microbatch' per-stage "
+                                       "batcher counters",
                         "content": {"application/json": {"schema": {
                             "$ref": "#/components/schemas/"
                                     "MetricsResponse"}}}},

@@ -1,0 +1,105 @@
+"""Micro-batching smoke: 16 concurrent embed+search RAG front-halves on
+CPU with the cross-request batcher ON must (a) coalesce — mean batch
+size > 1 and fewer device dispatches than callers — and (b) return
+results identical to the batcher-OFF sequential path. CI-grade: exits
+nonzero on any violation, prints one JSON summary line.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/smoke_microbatch.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_CALLERS = 16
+WAIT_US = 100_000  # generous window: CI thread skew must still coalesce
+
+
+def main() -> int:
+    import jax
+
+    from generativeaiexamples_tpu.models import bert
+    from generativeaiexamples_tpu.rag.vectorstore import TPUVectorStore
+    from generativeaiexamples_tpu.serving.encoders import EmbeddingEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    bcfg = bert.BertConfig.tiny(vocab_size=512)
+    emb = EmbeddingEngine(bert.init_params(bcfg, jax.random.PRNGKey(1)),
+                          bcfg, ByteTokenizer())
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((512, bcfg.dim)).astype(np.float32)
+    corpus /= np.linalg.norm(corpus, axis=1, keepdims=True)
+    store = TPUVectorStore(bcfg.dim)
+    store.add([f"chunk-{i}" for i in range(512)], corpus)
+
+    queries = [f"question {i} about topic {i % 4}" for i in range(N_CALLERS)]
+
+    def front_half(q):
+        vec = emb.embed_query(q)
+        return vec, [(r.text, round(r.score, 6))
+                     for r in store.search(vec, top_k=4)]
+
+    # -- batcher OFF: the sequential reference ---------------------------
+    ref = [front_half(q) for q in queries]
+
+    # -- batcher ON: 16 threads released together ------------------------
+    emb.enable_microbatch(max_batch=N_CALLERS, max_wait_us=WAIT_US)
+    store.enable_microbatch(max_batch=N_CALLERS, max_wait_us=WAIT_US)
+    got = [None] * N_CALLERS
+    errs = []
+    bar = threading.Barrier(N_CALLERS)
+
+    def run(i):
+        try:
+            bar.wait()
+            got[i] = front_half(queries[i])
+        except BaseException as e:
+            errs.append(f"{type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(N_CALLERS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    embed_snap = emb.microbatch_stats()
+    search_snap = store.microbatch_stats()
+    equal = not errs and all(
+        np.array_equal(rv, gv) and rh == gh
+        for (rv, rh), (gv, gh) in zip(ref, got))
+    dispatches = embed_snap["dispatches"] + search_snap["dispatches"]
+    coalesced = (embed_snap["mean_batch_size"] or 0) > 1
+
+    out = {
+        "callers": N_CALLERS,
+        "equal_to_batcher_off": bool(equal),
+        "embed_dispatches": embed_snap["dispatches"],
+        "embed_mean_batch": embed_snap["mean_batch_size"],
+        "search_dispatches": search_snap["dispatches"],
+        "search_mean_batch": search_snap["mean_batch_size"],
+        "total_dispatches": dispatches,
+        "wall_s": round(wall, 3),
+        "errors": errs,
+    }
+    ok = (equal and coalesced
+          and dispatches < 2 * N_CALLERS)  # embed+search per caller = 2N
+    out["ok"] = bool(ok)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

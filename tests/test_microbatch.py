@@ -1,0 +1,496 @@
+"""Cross-request dynamic micro-batching (serving/batcher.py).
+
+Correctness contract: N threads hammering embed_query / score / search
+with the batcher ON produce results identical to sequential calls with
+the batcher OFF, while the dispatch counter shows FEWER device calls
+than callers. Plus the generic MicroBatcher semantics (bucket
+isolation, max_batch cap, error propagation) and the config / server
+wiring.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.config.wizard import load_config
+from generativeaiexamples_tpu.rag.vectorstore import (
+    MemoryVectorStore, TPUVectorStore)
+from generativeaiexamples_tpu.serving.batcher import (
+    MicroBatchedEmbedder, MicroBatcher, enable_embedder_microbatch,
+    microbatch_stats_of)
+
+# Long window so slow-CI thread skew still coalesces; the barrier in
+# _hammer releases all threads at once, so in practice dispatch happens
+# as soon as everyone has queued.
+WAIT_US = 200_000
+
+
+def _hammer(n, fn):
+    """Run fn(i) on n threads released simultaneously; return results."""
+    out = [None] * n
+    errs = []
+    bar = threading.Barrier(n)
+
+    def run(i):
+        try:
+            bar.wait()
+            out[i] = fn(i)
+        except BaseException as e:  # surface in the test thread
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+    return out
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_callers(self):
+        batches = []
+
+        def fn(items):
+            batches.append(list(items))
+            return [x * 10 for x in items]
+
+        b = MicroBatcher("t", fn, max_batch=16, max_wait_us=WAIT_US)
+        got = _hammer(8, lambda i: b.submit(i))
+        assert got == [i * 10 for i in range(8)]
+        snap = b.stats.snapshot()
+        assert snap["submitted"] == 8
+        assert snap["dispatches"] < 8  # coalescing observed
+        assert snap["dispatches_saved"] == 8 - snap["dispatches"]
+        assert snap["mean_batch_size"] > 1
+        assert sum(len(g) for g in batches) == 8
+
+    def test_bucket_keys_never_mix(self):
+        batches = []
+
+        def fn(items):
+            batches.append(list(items))
+            return items
+
+        b = MicroBatcher("t", fn, max_batch=16, max_wait_us=WAIT_US,
+                         bucket_fn=lambda x: x % 2)
+        _hammer(10, lambda i: b.submit(i))
+        for g in batches:
+            assert len({x % 2 for x in g}) == 1  # one bucket per dispatch
+
+    def test_max_batch_caps_group_size(self):
+        batches = []
+
+        def fn(items):
+            batches.append(list(items))
+            return items
+
+        b = MicroBatcher("t", fn, max_batch=4, max_wait_us=WAIT_US)
+        _hammer(10, lambda i: b.submit(i))
+        assert all(len(g) <= 4 for g in batches)
+
+    def test_submit_many_preserves_order(self):
+        b = MicroBatcher("t", lambda xs: [x + 1 for x in xs],
+                         max_batch=4, max_wait_us=0,
+                         bucket_fn=lambda x: x % 3)
+        assert b.submit_many(list(range(9))) == [i + 1 for i in range(9)]
+        assert b.submit_many([]) == []
+
+    def test_error_propagates_to_every_caller(self):
+        def fn(items):
+            raise RuntimeError("boom")
+
+        b = MicroBatcher("t", fn, max_batch=16, max_wait_us=WAIT_US)
+        with pytest.raises(RuntimeError, match="boom"):
+            _hammer(4, lambda i: b.submit(i))
+
+    def test_result_length_mismatch_is_an_error(self):
+        b = MicroBatcher("t", lambda xs: [1], max_batch=8,
+                         max_wait_us=WAIT_US)
+        with pytest.raises(RuntimeError, match="results"):
+            _hammer(3, lambda i: b.submit(i))
+
+    def test_submit_after_close_raises(self):
+        b = MicroBatcher("t", lambda xs: xs, max_batch=4, max_wait_us=0)
+        assert b.submit("x") == "x"
+        b.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            b.submit("y")
+
+    def test_rejects_bad_max_batch(self):
+        with pytest.raises(ValueError):
+            MicroBatcher("t", lambda xs: xs, max_batch=0)
+
+
+@pytest.fixture(scope="module")
+def embed_engine():
+    from generativeaiexamples_tpu.models import bert
+    from generativeaiexamples_tpu.serving.encoders import EmbeddingEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = bert.BertConfig.tiny(vocab_size=512)
+    return EmbeddingEngine(bert.init_params(cfg, jax.random.PRNGKey(1)),
+                           cfg, ByteTokenizer())
+
+
+@pytest.fixture(scope="module")
+def rerank_engine():
+    from generativeaiexamples_tpu.models import bert
+    from generativeaiexamples_tpu.serving.encoders import RerankEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = bert.BertConfig(vocab_size=512, dim=32, n_layers=2, n_heads=2,
+                          mlp_dim=64, max_position=64, n_labels=1)
+    return RerankEngine(bert.init_params(cfg, jax.random.PRNGKey(2)),
+                        cfg, ByteTokenizer())
+
+
+class TestEmbeddingEngineMicrobatch:
+    def test_concurrent_equals_sequential_fewer_dispatches(self, embed_engine):
+        texts = [f"query number {i} about subject {i % 3}" for i in range(16)]
+        want = np.stack([embed_engine.embed_query(t) for t in texts])
+        embed_engine.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = np.stack(_hammer(
+                16, lambda i: embed_engine.embed_query(texts[i])))
+            snap = embed_engine.microbatch_stats()
+        finally:
+            embed_engine.disable_microbatch()
+        # byte-identical: rows are batch-independent in the forward
+        assert np.array_equal(want, got)
+        assert snap["submitted"] == 16
+        assert snap["dispatches"] < 16
+        assert snap["mean_batch_size"] > 1
+
+    def test_whole_call_is_one_item(self, embed_engine):
+        """A multi-text call counts as ONE submitted item — counters
+        read in caller units, and a lone wide call claims no savings."""
+        texts = [f"doc {i}" for i in range(40)]
+        want = embed_engine.embed(texts)
+        embed_engine.enable_microbatch(max_batch=8, max_wait_us=WAIT_US)
+        try:
+            got = embed_engine.embed(texts)
+            snap = embed_engine.microbatch_stats()
+        finally:
+            embed_engine.disable_microbatch()
+        assert np.array_equal(want, got)
+        assert snap["submitted"] == 1
+        assert snap["dispatches_saved"] == 0
+
+    def test_short_calls_never_ride_long_buckets(self, embed_engine):
+        """Calls merge only within a `_bucket` rung: a short query is
+        never dragged into a long document's padding width."""
+        texts = ["ab"] * 8 + ["x" * 50]  # buckets 32 vs 64 (tiny cfg)
+        want = [embed_engine.embed([t])[0] for t in texts]
+        embed_engine.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(9, lambda i: embed_engine.embed([texts[i]])[0])
+            snap = embed_engine.microbatch_stats()
+        finally:
+            embed_engine.disable_microbatch()
+        assert np.array_equal(np.stack(want), np.stack(got))
+        # one dispatch per bucket, never one mixed dispatch
+        assert snap["dispatches"] >= 2
+        assert snap["max_batch_size"] <= 8
+
+    def test_closed_batcher_falls_back_to_direct(self, embed_engine):
+        """A caller holding a batcher closed by a racing disable/
+        re-enable must be served by the direct path, not crash."""
+        want = embed_engine.embed_query("race me")
+        b = embed_engine.enable_microbatch(max_batch=8,
+                                           max_wait_us=WAIT_US)
+        try:
+            b.close()  # simulate the disable racing this caller
+            got = embed_engine.embed_query("race me")
+        finally:
+            embed_engine.disable_microbatch()
+        assert np.array_equal(want, got)
+
+    def test_stats_none_when_off(self, embed_engine):
+        assert embed_engine.microbatch_stats() is None
+
+
+class TestRerankEngineMicrobatch:
+    def test_concurrent_sets_split_back_per_caller(self, rerank_engine):
+        passages = [f"passage {i} with some words" for i in range(6)]
+        jobs = [(f"question {i}", passages[: 3 + i % 3]) for i in range(8)]
+        want = [rerank_engine.score(q, ps) for q, ps in jobs]
+        rerank_engine.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(8, lambda i: rerank_engine.score(*jobs[i]))
+            snap = rerank_engine.microbatch_stats()
+        finally:
+            rerank_engine.disable_microbatch()
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+        assert snap["submitted"] == len(jobs)  # one item per caller
+        assert snap["dispatches"] < snap["submitted"]
+
+
+class TestStoreMicrobatch:
+    @pytest.mark.parametrize("cls", [MemoryVectorStore, TPUVectorStore])
+    def test_concurrent_equals_sequential(self, cls):
+        rng = np.random.default_rng(0)
+        vecs = rng.standard_normal((300, 16)).astype(np.float32)
+        vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+        store = cls(16)
+        store.add([f"t{i}" for i in range(300)], vecs)
+        queries = rng.standard_normal((16, 16)).astype(np.float32)
+        want = [store.search(q, top_k=3) for q in queries]
+        store.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(16, lambda i: store.search(queries[i], top_k=3))
+            snap = store.microbatch_stats()
+        finally:
+            store.disable_microbatch()
+        for w, g in zip(want, got):
+            assert [r.text for r in w] == [r.text for r in g]
+            np.testing.assert_allclose([r.score for r in w],
+                                       [r.score for r in g], atol=1e-5)
+        assert snap["submitted"] == 16
+        assert snap["dispatches"] < 16  # one GEMM served many callers
+        assert store.microbatch_stats() is None  # off again
+
+    def test_tpu_group_padding_stays_invisible(self):
+        """TPU coalesced groups pad to a power of two (bounded compile
+        shapes); padding rows must not leak into results or the
+        searches counter."""
+        rng = np.random.default_rng(3)
+        vecs = rng.standard_normal((200, 16)).astype(np.float32)
+        store = TPUVectorStore(16)
+        store.add([f"t{i}" for i in range(200)], vecs)
+        queries = rng.standard_normal((5, 16)).astype(np.float32)
+        want = [store.search(q, top_k=3) for q in queries]
+        base = store.stats()["searches"]
+        store.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(5, lambda i: store.search(queries[i], top_k=3))
+        finally:
+            store.disable_microbatch()
+        for w, g in zip(want, got):
+            assert [r.text for r in w] == [r.text for r in g]
+        assert store.stats()["searches"] == base + 5  # not the padded 8
+
+    def test_ivf_training_never_blocks_the_dispatcher(self):
+        """Under the batcher, lazy IVF training is kicked to a
+        background thread: coalesced searches serve the exact fallback
+        immediately (correct results), and the trained index installs
+        shortly after."""
+        import time
+
+        rng = np.random.default_rng(4)
+        vecs = rng.standard_normal((2048, 16)).astype(np.float32)
+        vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+        store = TPUVectorStore(16, index_type="ivf", nlist=16, nprobe=16)
+        store.recall_sample_every = 1 << 30
+        store.add([f"t{i}" for i in range(2048)], vecs)
+        queries = rng.standard_normal((8, 16)).astype(np.float32)
+        store.enable_microbatch(max_batch=8, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(8, lambda i: store.search(queries[i], top_k=4))
+            assert all(len(r) == 4 for r in got)  # exact fallback served
+            deadline = time.time() + 30
+            while store.stats()["index"] != "ivf" and time.time() < deadline:
+                store.search(queries[0], top_k=4)
+                time.sleep(0.05)
+            assert store.stats()["index"] == "ivf"  # trainer landed
+        finally:
+            store.disable_microbatch()
+
+    def test_empty_store_padded_group_keeps_counters_clean(self):
+        """A coalesced group against an empty store must return empties
+        and leave the searches counter at zero (not negative)."""
+        store = TPUVectorStore(8)
+        store.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(3, lambda i: store.search(
+                np.ones(8, np.float32), top_k=2))
+        finally:
+            store.disable_microbatch()
+        assert got == [[], [], []]
+        assert store.stats()["searches"] == 0
+
+    def test_different_top_k_never_merge(self):
+        rng = np.random.default_rng(1)
+        vecs = rng.standard_normal((64, 8)).astype(np.float32)
+        store = MemoryVectorStore(8)
+        store.add([f"t{i}" for i in range(64)], vecs)
+        q = rng.standard_normal((8,)).astype(np.float32)
+        store.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            got = _hammer(8, lambda i: store.search(q, top_k=1 + i % 2))
+        finally:
+            store.disable_microbatch()
+        for i, res in enumerate(got):
+            assert len(res) == 1 + i % 2
+
+    def test_search_batch_stays_direct(self):
+        rng = np.random.default_rng(2)
+        vecs = rng.standard_normal((64, 8)).astype(np.float32)
+        store = MemoryVectorStore(8)
+        store.add([f"t{i}" for i in range(64)], vecs)
+        store.enable_microbatch(max_batch=16, max_wait_us=WAIT_US)
+        try:
+            out = store.search_batch(rng.standard_normal((4, 8)), top_k=2)
+            assert len(out) == 4
+            assert store.microbatch_stats()["submitted"] == 0
+        finally:
+            store.disable_microbatch()
+
+
+class TestConnectorWrapper:
+    def test_wraps_engineless_embedder(self):
+        from generativeaiexamples_tpu.connectors.fakes import HashEmbedder
+
+        inner = HashEmbedder(32)
+        wrapped = enable_embedder_microbatch(inner, max_batch=16,
+                                             max_wait_us=WAIT_US)
+        assert isinstance(wrapped, MicroBatchedEmbedder)
+        texts = [f"query {i}" for i in range(12)]
+        want = np.stack([inner.embed_query(t) for t in texts])
+        got = np.stack(_hammer(12, lambda i: wrapped.embed_query(texts[i])))
+        assert np.array_equal(want, got)
+        snap = wrapped.microbatch_stats()
+        assert snap["submitted"] == 12 and snap["dispatches"] < 12
+        # delegation: batched + doc entry points and attrs pass through
+        assert wrapped.dim == 32
+        assert np.array_equal(wrapped.embed_queries(texts),
+                              inner.embed_queries(texts))
+        assert np.array_equal(wrapped.embed_documents(texts[:3]),
+                              inner.embed_documents(texts[:3]))
+
+    def test_engine_embedder_batched_at_engine_level(self, embed_engine):
+        from generativeaiexamples_tpu.connectors.local import LocalEmbedder
+
+        conn = LocalEmbedder(embed_engine)
+        try:
+            back = enable_embedder_microbatch(conn, max_batch=8,
+                                              max_wait_us=1000)
+            assert back is conn  # no wrapper: engine batches internally
+            assert microbatch_stats_of(conn) is not None
+        finally:
+            embed_engine.disable_microbatch()
+
+    def test_reranker_none_passthrough(self):
+        from generativeaiexamples_tpu.serving.batcher import (
+            enable_reranker_microbatch)
+
+        assert enable_reranker_microbatch(None) is None
+        assert microbatch_stats_of(None) is None
+
+
+class TestConfigAndWiring:
+    def test_defaults_off(self):
+        cfg = load_config(path="", env={})
+        assert cfg.serving.microbatch_enabled is False
+        assert cfg.serving.microbatch_max_batch == 16
+        assert cfg.serving.executor_workers == 64
+
+    def test_env_overrides(self):
+        cfg = load_config(path="", env={
+            "APP_SERVING_MICROBATCHENABLED": "true",
+            "APP_SERVING_MICROBATCHMAXBATCH": "32",
+            "APP_SERVING_MICROBATCHMAXWAITUS": "500",
+            "APP_SERVING_EXECUTORWORKERS": "128"})
+        assert cfg.serving.microbatch_enabled is True
+        assert cfg.serving.microbatch_max_batch == 32
+        assert cfg.serving.microbatch_max_wait_us == 500
+        assert cfg.serving.executor_workers == 128
+
+    def test_resources_wiring_on_and_off(self):
+        from generativeaiexamples_tpu.connectors.fakes import (
+            EchoLLM, HashEmbedder)
+        from generativeaiexamples_tpu.pipelines.resources import Resources
+
+        on = load_config(path="", env={"APP_SERVING_MICROBATCHENABLED": "1"})
+        res = Resources(on, llm=EchoLLM(), embedder=HashEmbedder(64),
+                        reranker=None)
+        assert isinstance(res.embedder, MicroBatchedEmbedder)
+        assert res.store.microbatch_stats() is not None
+        assert res.conv_store.microbatch_stats() is None  # scratch store
+        stats = res.retriever.microbatch_stats()
+        assert set(stats) == {"embed", "search"}  # no reranker stage
+
+        off = load_config(path="", env={})
+        res2 = Resources(off, llm=EchoLLM(), embedder=HashEmbedder(64),
+                         reranker=None)
+        assert isinstance(res2.embedder, HashEmbedder)  # untouched
+        assert res2.retriever.microbatch_stats() == {}
+
+
+class TestServerSurface:
+    def _server(self, tmp_path, env):
+        from generativeaiexamples_tpu.api.server import ChainServer
+        from generativeaiexamples_tpu.connectors.fakes import (
+            EchoLLM, HashEmbedder)
+        from generativeaiexamples_tpu.pipelines.base import get_example_class
+        from generativeaiexamples_tpu.pipelines.resources import Resources
+
+        cfg = load_config(path="", env=env)
+        res = Resources(cfg, llm=EchoLLM(), embedder=HashEmbedder(64),
+                        reranker=None)
+        ex = get_example_class("developer_rag")(res)
+        return ChainServer(cfg, example=ex, upload_dir=str(tmp_path / "up"))
+
+    def _call(self, server, fn):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def runner():
+            client = TestClient(TestServer(server.app))
+            await client.start_server()
+            try:
+                return await fn(client)
+            finally:
+                await client.close()
+
+        return asyncio.run(runner())
+
+    def test_metrics_reports_batcher_counters(self, tmp_path):
+        srv = self._server(tmp_path,
+                           {"APP_SERVING_MICROBATCHENABLED": "1"})
+        srv.example.document_search("what is a tpu", 2)
+
+        async def body(c):
+            return await (await c.get("/metrics")).json()
+
+        payload = self._call(srv, body)
+        assert "microbatch" in payload
+        assert payload["microbatch"]["embed"]["submitted"] >= 1
+        assert payload["microbatch"]["search"]["dispatches"] >= 1
+        # the batcher counters live ONLY under "microbatch" — store
+        # stats must not duplicate them (double-counting dashboards)
+        assert "microbatch" not in payload["vector_store"]
+
+    def test_metrics_empty_section_when_off(self, tmp_path):
+        srv = self._server(tmp_path, {})
+
+        async def body(c):
+            return await (await c.get("/metrics")).json()
+
+        payload = self._call(srv, body)
+        assert payload["microbatch"] == {}
+
+    def test_generate_prunes_duplicated_user_turn_by_index(self, tmp_path):
+        """chat_history.remove(m) deleted the FIRST equal-value turn; a
+        duplicated user message must prune the LAST one (the query)."""
+        srv = self._server(tmp_path, {})
+
+        async def body(c):
+            r = await c.post("/generate", json={
+                "messages": [{"role": "user", "content": "same words"},
+                             {"role": "assistant", "content": "a reply"},
+                             {"role": "user", "content": "same words"}],
+                "use_knowledge_base": False, "max_tokens": 16})
+            return (await r.read()).decode()
+
+        self._call(srv, body)
+        sent = srv.example.res.llm.calls[0]
+        # system + intact earlier history + the query turn appended last
+        assert [m["role"] for m in sent] == \
+            ["system", "user", "assistant", "user"]
+        assert sent[2]["content"] == "a reply"

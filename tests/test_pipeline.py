@@ -17,6 +17,17 @@ from generativeaiexamples_tpu.training import trainer
 
 TINY = llama.LlamaConfig.tiny()
 
+# pipeline_loss partitions stages with the new-API
+# `jax.shard_map(axis_names=...)`; the pre-0.5 experimental shard_map
+# has no spelling that actually partitions over only the pipeline axis
+# (CHANGES PR 2 rider), so on old jax these two tests cannot run — gate
+# them explicitly instead of letting them fail red.
+requires_new_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs new-API jax.shard_map(axis_names=...); the old "
+           "experimental shard_map cannot express the GPipe stage "
+           "partitioning on this jax version")
+
 
 @pytest.fixture(scope="module")
 def pp_mesh(eight_devices):
@@ -27,6 +38,7 @@ def pp_mesh(eight_devices):
 
 
 class TestPipelineLoss:
+    @requires_new_shard_map
     def test_matches_unpipelined_loss_and_grads(self, pp_mesh):
         params = llama.init_params(TINY, jax.random.PRNGKey(0))
         batch = trainer.synthetic_batch(TINY, batch=8, seq=16)
@@ -87,6 +99,7 @@ class TestPipelineLoss:
 
 
 class TestPipelineTrainStep:
+    @requires_new_shard_map
     def test_full_step_updates_params(self, pp_mesh):
         params = llama.init_params(TINY, jax.random.PRNGKey(0))
         tcfg = trainer.TrainConfig(learning_rate=1e-3, warmup_steps=1,
