@@ -45,6 +45,17 @@ Scenario output keys (under "extras"):
                  dynamic-batcher role; BENCH_CONCURRENT=0 skips)
 
 `python bench.py --help` prints this header and exits.
+
+Sibling tooling (same checkout):
+  scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_microbatch.py
+      targeted CPU smoke gates for the serving subsystems
+  python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/
+      graftlint static analysis (trace purity, lock discipline, thread
+      hygiene, host-sync, config drift; docs/static_analysis.md) —
+      also via scripts/lint.py [--ruff]
+  scripts/ci_checks.sh
+      the full check pipeline: graftlint + ruff + config-docs drift +
+      tier-1 pytest
 """
 
 from __future__ import annotations

@@ -1,0 +1,28 @@
+"""graftlint: JAX-serving-aware static analysis for this repo.
+
+The serving stack is heavily multithreaded (engine scheduler/reader/
+pacer threads, micro-batcher dispatchers, single-flight sidecar
+writers, background k-means) and leans on jit tracing for every hot
+path. The bug classes that sink such systems — attributes mutated both
+with and without their lock, traced-value host syncs inside `jax.jit`,
+broad `except` swallowing on daemon threads, config knobs drifting out
+of the generated docs — are invisible to pytest. graftlint is the
+AST-based pass that makes them visible:
+
+- ``python -m generativeaiexamples_tpu.lint <paths>`` (or
+  ``scripts/lint.py``) runs every check; exit 0 = clean, 1 = findings,
+  2 = usage error.
+- Checks are plugins under ``lint/checks/`` (see
+  ``docs/static_analysis.md`` for the catalog and how to add one).
+- Justified findings live in the checked-in ``lint-baseline.json``
+  (content-hash keyed, so line drift and file moves don't invalidate
+  suppressions), each with a human reason string.
+- ``tests/test_lint.py`` gates regressions: every check must fire on
+  its seeded-violation fixture and the shipped tree must have zero
+  non-baselined findings.
+"""
+
+from generativeaiexamples_tpu.lint.core import (  # noqa: F401
+    Finding, Project, SourceFile, all_checks, load_project, run_checks)
+from generativeaiexamples_tpu.lint.baseline import Baseline  # noqa: F401
+from generativeaiexamples_tpu.lint.cli import lint_paths, main  # noqa: F401

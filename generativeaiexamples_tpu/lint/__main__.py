@@ -1,0 +1,5 @@
+import sys
+
+from generativeaiexamples_tpu.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,22 @@
+"""graftlint check plugins. Adding a check = new module here defining
+a `Check` subclass, listed in ALL_CHECKS (docs/static_analysis.md has
+the walkthrough)."""
+
+from generativeaiexamples_tpu.lint.checks.trace_purity import \
+    TracePurityCheck
+from generativeaiexamples_tpu.lint.checks.lock_discipline import \
+    LockDisciplineCheck
+from generativeaiexamples_tpu.lint.checks.thread_hygiene import (
+    ThreadDaemonCheck, ThreadSwallowCheck)
+from generativeaiexamples_tpu.lint.checks.host_sync import HostSyncCheck
+from generativeaiexamples_tpu.lint.checks.config_drift import \
+    ConfigDriftCheck
+
+ALL_CHECKS = [
+    TracePurityCheck,
+    LockDisciplineCheck,
+    ThreadDaemonCheck,
+    ThreadSwallowCheck,
+    HostSyncCheck,
+    ConfigDriftCheck,
+]

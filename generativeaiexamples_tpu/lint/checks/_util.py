@@ -1,0 +1,123 @@
+"""Shared AST helpers for graftlint checks."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute chains / Names; None for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def last_part(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def str_constants(node: ast.AST) -> List[str]:
+    """String literals inside a constant / tuple / list expression."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+    return out
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """True for an expression naming jax.jit (jit / jax.jit / pjit)."""
+    return last_part(dotted(node)) in ("jit", "pjit")
+
+
+def jit_static_argnames(deco: ast.AST) -> Optional[Set[str]]:
+    """If `deco` makes a function jitted, return its static_argnames
+    (empty set when none); else None.
+
+    Recognized shapes: @jax.jit, @jit, @jax.jit(static_argnames=...),
+    @functools.partial(jax.jit, static_argnames=...), @partial(jit, ...).
+    """
+    if is_jit_expr(deco):
+        return set()
+    if not isinstance(deco, ast.Call):
+        return None
+    func = deco.func
+    if is_jit_expr(func):                       # jax.jit(**kw)
+        return _static_names(deco.keywords)
+    if last_part(dotted(func)) == "partial" and deco.args \
+            and is_jit_expr(deco.args[0]):      # partial(jax.jit, **kw)
+        return _static_names(deco.keywords)
+    return None
+
+
+def _static_names(keywords: Iterable[ast.keyword]) -> Set[str]:
+    names: Set[str] = set()
+    for kw in keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            names.update(str_constants(kw.value))
+    return names
+
+
+def param_names(fn) -> List[str]:
+    """Positional + kw-only parameter names (self/cls dropped)."""
+    a = fn.args
+    names = [p.arg for p in
+             list(getattr(a, "posonlyargs", [])) + a.args + a.kwonlyargs]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def self_attr_target(node: ast.AST) -> Optional[str]:
+    """'_x' when node is `self._x`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def iter_functions(tree: ast.AST):
+    """Every (Async)FunctionDef in the module, including nested."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_stop_at_functions(node: ast.AST, *, include_root: bool = True):
+    """Walk `node` without descending into nested function/class
+    definitions (their bodies run in a different context)."""
+    stack = [node] if include_root else list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(c)
+
+
+def docstring_of(fn) -> str:
+    try:
+        return ast.get_docstring(fn) or ""
+    except TypeError:
+        return ""
+
+
+def handler_catches_broadly(handler: ast.ExceptHandler) -> Optional[str]:
+    """'bare' / 'Exception' / 'BaseException' when the handler is
+    broad, else None."""
+    t = handler.type
+    if t is None:
+        return "bare"
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for el in types:
+        name = last_part(dotted(el))
+        if name in ("Exception", "BaseException"):
+            return name
+    return None

@@ -1,0 +1,108 @@
+"""GL401 host-sync-in-hot-path: blocking device->host syncs on the
+engine step loop / batcher dispatch path.
+
+The serving engine's throughput hinges on the scheduler thread never
+blocking on the device: dispatches are async, and the ONLY sanctioned
+blocking fetch is the oldest in-flight block (overlapped with device
+compute; see engine.py `_loop`). A stray `block_until_ready`,
+`jax.device_get`, or `np.asarray(self._device_thing)` on that path
+serializes the pipeline and silently halves tokens/sec — no test
+fails, the benchmark just gets slower.
+
+Scope: functions are "hot" when (a) they are the known step-loop /
+dispatch functions of `serving/engine.py` and `serving/batcher.py`, or
+(b) their `def` line carries a `# graftlint: hot-path` marker (how new
+hot paths opt in). Flagged inside a hot function:
+
+- `.block_until_ready(...)` / `jax.block_until_ready(...)`
+- `jax.device_get(...)`
+- `np.asarray(...)` / `np.array(...)` of a `self.*` attribute or of a
+  name that looks device-resident (`*_dev`, `dev_*`, `*device*`) —
+  the implicit-conversion sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Set
+
+from generativeaiexamples_tpu.lint.core import Check, Finding, Project, \
+    SourceFile
+from generativeaiexamples_tpu.lint.checks import _util as u
+
+HOT_PATH_MARK = re.compile(r"#\s*graftlint:\s*hot-path")
+# Known hot functions per module basename: the engine scheduler beat
+# and the micro-batcher dispatcher. Extend via the marker comment.
+HOT_DEFAULTS = {
+    "engine.py": {"_loop", "_admit_waiting", "_dispatch_decode",
+                  "_dispatch_decode_spec", "_advance_long_prefills",
+                  "_emit_ready_first_tokens"},
+    "batcher.py": {"_loop", "_run", "_take_group"},
+}
+DEVICE_NAME_RE = re.compile(r"(^|_)dev(_|$)|device", re.IGNORECASE)
+NUMPY_MODULES = ("np", "numpy", "onp")
+
+
+class HostSyncCheck(Check):
+    id = "GL401"
+    name = "host-sync-hot-path"
+    severity = "warning"
+    describe = ("block_until_ready / device_get / implicit np. "
+                "conversion inside the engine step loop or batcher "
+                "dispatch path")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            base = os.path.basename(sf.path)
+            defaults: Set[str] = HOT_DEFAULTS.get(base, set())
+            for fn in u.iter_functions(sf.tree):
+                if not self._is_hot(sf, fn, defaults):
+                    continue
+                yield from self._scan(sf, fn)
+
+    def _is_hot(self, sf: SourceFile, fn, defaults: Set[str]) -> bool:
+        if fn.name in defaults:
+            return True
+        # marker on the def line or the line above it
+        for lineno in (fn.lineno, fn.lineno - 1):
+            if HOT_PATH_MARK.search(sf.line(lineno)):
+                return True
+        return False
+
+    def _scan(self, sf: SourceFile, fn) -> Iterable[Finding]:
+        for node in u.walk_stop_at_functions(fn, include_root=False):
+            if not isinstance(node, ast.Call):
+                continue
+            name = u.dotted(node.func)
+            last = u.last_part(name)
+            if last == "block_until_ready":
+                yield self.finding(
+                    sf, node.lineno,
+                    "block_until_ready on the hot path stalls the "
+                    "dispatch pipeline; fetch on the reader thread / "
+                    "overlap with device compute instead")
+            elif last == "device_get":
+                yield self.finding(
+                    sf, node.lineno,
+                    "jax.device_get on the hot path is a synchronous "
+                    "device->host round trip; defer the fetch or hand "
+                    "it to the reader thread")
+            elif last in ("asarray", "array") and name \
+                    and name.split(".")[0] in NUMPY_MODULES \
+                    and node.args and self._looks_device(node.args[0]):
+                yield self.finding(
+                    sf, node.lineno,
+                    f"{name}() of a device value on the hot path is an "
+                    f"implicit blocking transfer; copy_to_host_async + "
+                    f"drain later, or move it off this thread")
+
+    def _looks_device(self, arg: ast.AST) -> bool:
+        if u.self_attr_target(arg) is not None:
+            return True
+        if isinstance(arg, ast.Name) and DEVICE_NAME_RE.search(arg.id):
+            return True
+        return False

@@ -1,0 +1,182 @@
+"""GL1xx trace-purity: host syncs and Python control flow on traced
+values inside `jax.jit`-compiled functions.
+
+Inside a jitted function every non-static argument is a tracer:
+`.item()`, `float()/int()/bool()`, and `np.asarray()` force a host
+sync (or fail outright under jit), and Python `if`/`while` on a traced
+expression raises ConcretizationTypeError at trace time — but only on
+the code path that actually traces, so pytest coverage gaps hide them.
+
+Recognized jit shapes: `@jax.jit`, `@jit`, `@jax.jit(...)`,
+`@functools.partial(jax.jit, static_argnames=...)`, and
+`jax.jit(lambda ...: ...)` / `jax.jit(fn)` value wrapping. Parameters
+listed in `static_argnames`/`static_argnums` are concrete Python
+values — control flow on them is fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from generativeaiexamples_tpu.lint.core import Check, Finding, Project, \
+    SourceFile
+from generativeaiexamples_tpu.lint.checks import _util as u
+
+NUMPY_MODULES = ("np", "numpy", "onp")
+# Attribute reads that are static under tracing (shape metadata).
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# Calls whose results are concrete even on tracers (dtype/shape
+# predicates included: they inspect the abstract value, not the data).
+STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id",
+                "callable", "range", "enumerate", "zip",
+                "iscomplexobj", "isrealobj", "issubdtype"}
+
+
+class TracePurityCheck(Check):
+    id = "GL101"
+    name = "trace-purity"
+    severity = "error"
+    describe = ("host sync (.item()/float()/np.asarray) or Python "
+                "control flow on traced values inside jax.jit")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            yield from self._check_file(sf)
+
+    # -- per-file ----------------------------------------------------------
+
+    def _check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        jit_wrapped = self._value_wrapped_names(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                static = self._decorated_static(node)
+                if static is None and node.name in jit_wrapped:
+                    static = jit_wrapped[node.name]
+                if static is None:
+                    continue
+                traced = set(u.param_names(node)) - static
+                yield from self._scan_body(sf, node, traced)
+            elif isinstance(node, ast.Call) and u.is_jit_expr(node.func):
+                # jax.jit(lambda ...: ...) inline wrapping
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Lambda):
+                        traced = set(u.param_names(arg))
+                        yield from self._scan_body(sf, arg, traced)
+
+    def _decorated_static(self, fn) -> Optional[Set[str]]:
+        for deco in fn.decorator_list:
+            static = u.jit_static_argnames(deco)
+            if static is not None:
+                return static
+        return None
+
+    def _value_wrapped_names(self, tree: ast.Module):
+        """{fn_name: static_argnames} for `x = jax.jit(fn, ...)` /
+        `jax.jit(fn)` wrappings of functions defined in this module."""
+        out = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and u.is_jit_expr(node.func) \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                out[node.args[0].id] = u._static_names(node.keywords)
+        return out
+
+    # -- body scan ---------------------------------------------------------
+
+    def _scan_body(self, sf: SourceFile, fn,
+                   traced: Set[str]) -> Iterable[Finding]:
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                f = self._check_node(sf, node, traced)
+                if f is not None:
+                    yield f
+
+    def _check_node(self, sf: SourceFile, node: ast.AST,
+                    traced: Set[str]) -> Optional[Finding]:
+        if isinstance(node, ast.Call):
+            name = u.dotted(node.func)
+            last = u.last_part(name)
+            if isinstance(node.func, ast.Attribute) and last == "item" \
+                    and not node.args:
+                return self.finding(
+                    sf, node.lineno,
+                    ".item() inside a jitted function forces a device->"
+                    "host sync (fails under jit; move it outside the "
+                    "traced region)")
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and node.args and not _is_concrete(node.args[0], traced):
+                return self.finding(
+                    sf, node.lineno,
+                    f"{node.func.id}() on a possibly-traced value inside "
+                    f"jax.jit concretizes the tracer (host sync / "
+                    f"ConcretizationTypeError)")
+            if name and "." in name and name.split(".")[0] in NUMPY_MODULES \
+                    and last in ("asarray", "array") and node.args \
+                    and not _is_concrete(node.args[0], traced):
+                return self.finding(
+                    sf, node.lineno,
+                    f"{name}() materializes a traced value on the host "
+                    f"inside jax.jit; use jnp.{last} (traced) or move "
+                    f"the conversion outside the jitted function")
+        elif isinstance(node, (ast.If, ast.While)):
+            if _test_depends_on_traced(node.test, traced):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                return self.finding(
+                    sf, node.lineno,
+                    f"Python `{kind}` on a traced expression inside "
+                    f"jax.jit raises ConcretizationTypeError; use "
+                    f"jnp.where / lax.cond / lax.while_loop, or mark the "
+                    f"argument static")
+        return None
+
+
+def _is_concrete(node: ast.AST, traced: Set[str]) -> bool:
+    """Conservatively true only for literals and shape metadata — those
+    never force a sync."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return True
+    if isinstance(node, ast.Subscript):
+        # x.shape[0] is static; anything else subscripted is not known.
+        return _is_concrete(node.value, traced)
+    if isinstance(node, ast.Call):
+        return u.last_part(u.dotted(node.func)) in STATIC_CALLS
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_concrete(e, traced) for e in node.elts)
+    return False
+
+
+def _test_depends_on_traced(test: ast.AST, traced: Set[str]) -> bool:
+    """Does a condition dynamically depend on a traced parameter?
+    `x is None`, `isinstance(x, ...)`, `len(x)`, and `x.shape`-style
+    metadata are concrete at trace time and excluded."""
+    if isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return False
+    if isinstance(test, ast.Call):
+        return u.last_part(u.dotted(test.func)) not in STATIC_CALLS \
+            and any(_test_depends_on_traced(a, traced) for a in test.args)
+    if isinstance(test, ast.Name):
+        return test.id in traced
+    if isinstance(test, ast.Attribute):
+        if test.attr in STATIC_ATTRS:
+            return False
+        return _test_depends_on_traced(test.value, traced)
+    if isinstance(test, ast.Subscript):
+        return _test_depends_on_traced(test.value, traced)
+    if isinstance(test, ast.UnaryOp):
+        return _test_depends_on_traced(test.operand, traced)
+    if isinstance(test, ast.BoolOp):
+        return any(_test_depends_on_traced(v, traced) for v in test.values)
+    if isinstance(test, ast.BinOp):
+        return _test_depends_on_traced(test.left, traced) or \
+            _test_depends_on_traced(test.right, traced)
+    if isinstance(test, ast.Compare):
+        return _test_depends_on_traced(test.left, traced) or \
+            any(_test_depends_on_traced(c, traced) for c in test.comparators)
+    return False

@@ -1,0 +1,186 @@
+"""graftlint CLI: `python -m generativeaiexamples_tpu.lint [paths...]`.
+
+Exit-code contract (tests/test_lint.py pins it):
+  0 — clean (no findings after baseline + severity filtering)
+  1 — findings
+  2 — usage error (bad flag, unknown check id, missing path)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from generativeaiexamples_tpu.lint.baseline import Baseline
+from generativeaiexamples_tpu.lint.core import (
+    SEVERITIES, Finding, all_checks, load_project, run_checks)
+
+
+class UsageError(Exception):
+    pass
+
+
+def lint_paths(paths: Sequence[str], *, select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None,
+               baseline: Optional[Baseline] = None,
+               min_severity: str = "warning") -> List[Finding]:
+    """Programmatic entry point (tests use this): run the selected
+    checks over `paths`, apply the baseline and the severity floor,
+    return surviving findings."""
+    checks = resolve_checks(select, ignore)
+    project = load_project(paths)
+    findings = run_checks(project, checks)
+    floor = SEVERITIES.index(min_severity)
+    findings = [f for f in findings
+                if SEVERITIES.index(f.severity) >= floor]
+    if baseline is not None:
+        findings = baseline.filter(findings)
+    return findings
+
+
+def resolve_checks(select: Optional[Sequence[str]],
+                   ignore: Optional[Sequence[str]]) -> List:
+    known = {c.id: c for c in all_checks()}
+    # GL501 also emits GL502/GL503 (one plugin, three drift shapes);
+    # selection operates on the plugin's primary id.
+    def pick(ids: Sequence[str]) -> set:
+        out = set()
+        for i in ids:
+            i = i.strip()
+            if not i:
+                continue
+            if i not in known:
+                raise UsageError(
+                    f"unknown check id {i!r}; known: "
+                    f"{', '.join(sorted(known))}")
+            out.add(i)
+        return out
+
+    selected = pick(select) if select else set(known)
+    ignored = pick(ignore) if ignore else set()
+    return [cls() for cid, cls in sorted(known.items())
+            if cid in selected and cid not in ignored]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m generativeaiexamples_tpu.lint",
+        description="graftlint: JAX-serving-aware static analysis "
+                    "(trace purity, lock discipline, thread hygiene, "
+                    "host-sync, config drift)")
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="baseline suppression file (default: discover "
+                        "lint-baseline.json walking up from the inputs)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline, report everything")
+    p.add_argument("--write-baseline", metavar="FILE", nargs="?",
+                   const="lint-baseline.json",
+                   help="write current findings as a baseline and exit 0")
+    p.add_argument("--select", metavar="IDS",
+                   help="comma-separated check ids to run (default: all)")
+    p.add_argument("--ignore", metavar="IDS",
+                   help="comma-separated check ids to skip")
+    p.add_argument("--min-severity", choices=SEVERITIES, default="warning",
+                   help="report only findings at or above this severity")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-checks", action="store_true",
+                   help="print the check catalog and exit")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = _build_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors, 0 on --help; preserve both.
+        return int(e.code or 0)
+
+    if args.list_checks:
+        for c in all_checks():
+            print(f"{c.id}  {c.name:<22} [{c.severity}] {c.describe}")
+        return 0
+
+    if not args.paths:
+        print("error: no paths given (try `python -m "
+              "generativeaiexamples_tpu.lint generativeaiexamples_tpu/`)",
+              file=sys.stderr)
+        return 2
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"error: path does not exist: {p}", file=sys.stderr)
+            return 2
+
+    try:
+        checks = resolve_checks(
+            args.select.split(",") if args.select else None,
+            args.ignore.split(",") if args.ignore else None)
+    except UsageError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    project = load_project(args.paths)
+    findings = run_checks(project, checks)
+    floor = SEVERITIES.index(args.min_severity)
+    findings = [f for f in findings
+                if SEVERITIES.index(f.severity) >= floor]
+
+    if args.write_baseline:
+        # Merge reasons from the baseline being replaced (explicit or
+        # discovered): regenerating must not clobber curated entries.
+        try:
+            prev = (Baseline.load(args.write_baseline)
+                    if os.path.isfile(args.write_baseline)
+                    else Baseline.discover(args.paths))
+        except (OSError, ValueError, json.JSONDecodeError):
+            prev = None
+        Baseline.from_findings(findings, previous=prev).save(
+            args.write_baseline)
+        print(f"wrote {len(findings)} finding(s) to {args.write_baseline}; "
+              f"add a real reason to every entry you keep")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        try:
+            baseline = (Baseline.load(args.baseline) if args.baseline
+                        else Baseline.discover(args.paths))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+    suppressed = 0
+    if baseline is not None:
+        before = len(findings)
+        findings = baseline.filter(findings)
+        suppressed = before - len(findings)
+
+    if args.format == "json":
+        print(json.dumps([{
+            "check": f.check, "name": f.name, "severity": f.severity,
+            "path": f.path, "line": f.line, "message": f.message,
+            "hash": f.content_hash,
+        } for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        # Stale-entry reporting only makes sense when every check ran:
+        # a --select/--ignore run legitimately never exercises some
+        # baseline entries.
+        complete_run = not (args.select or args.ignore)
+        stale = baseline.unused_entries() \
+            if baseline is not None and complete_run else []
+        summary = (f"{len(findings)} finding(s), {suppressed} baselined"
+                   + (f", {len(stale)} STALE baseline entr"
+                      f"{'y' if len(stale) == 1 else 'ies'} "
+                      f"(fixed code — prune them)" if stale else ""))
+        print(summary)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ filename, mirroring the reference's /documents DELETE semantics
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from dataclasses import dataclass, field
@@ -44,6 +45,8 @@ import numpy as np
 
 from generativeaiexamples_tpu.serving.batcher import (
     MicroBatcher, MicroBatcherClosed, MicroBatchHost)
+
+_LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -262,6 +265,10 @@ class MemoryVectorStore(MicroBatchHost):
                 "ann_scanned_rows": 0,
                 "ann_recall_est": None,
                 "index_rebuilds": 0,
+                # Errors swallowed on background threads; the exact
+                # stores run none, the TPU store counts trainer /
+                # slow-worker failures here.
+                "background_errors": 0,
             }
 
     # -- document management ----------------------------------------------
@@ -326,11 +333,14 @@ class MemoryVectorStore(MicroBatchHost):
         vp = os.path.join(path, "vectors.npz")
         dp = os.path.join(path, "docs.jsonl")
         if os.path.isfile(vp) and os.path.isfile(dp):
-            self._vecs = np.load(vp)["vecs"].astype(np.float32)
-            with open(dp) as fh:
-                self._docs = [json.loads(ln) for ln in fh if ln.strip()]
-            self._load_extra(path)
-            self._on_update()
+            # Usually construction-time, but load() on a shared store
+            # must not let a concurrent search see vecs/docs mid-swap.
+            with self._lock:
+                self._vecs = np.load(vp)["vecs"].astype(np.float32)
+                with open(dp) as fh:
+                    self._docs = [json.loads(ln) for ln in fh if ln.strip()]
+                self._load_extra(path)
+                self._on_update()
 
     def _load_extra(self, path: str) -> None:
         pass
@@ -340,7 +350,8 @@ class MemoryVectorStore(MicroBatchHost):
             self.save(self.persist_dir)
 
     def _on_update(self) -> None:
-        pass  # hook for device-side mirrors
+        """Hook for device-side mirrors. Lock held (every mutator calls
+        it inside its own `with self._lock:`)."""
 
 
 class TPUVectorStore(MemoryVectorStore):
@@ -400,6 +411,10 @@ class TPUVectorStore(MemoryVectorStore):
         self._slow_busy = False
         self._slow_next_sidecar = None
         self._train_busy = False
+        # Errors swallowed on background threads (trainer / slow
+        # worker): logged AND counted so stats() stays honest — a
+        # daemon thread has no caller to propagate to.
+        self._bg_errors = 0
         # Serializes every ivf.npz write/unlink: the atomic-replace tmp
         # name is fixed, so concurrent writers (slow worker / trainer /
         # inline request threads / save()) would clobber each other's
@@ -411,6 +426,7 @@ class TPUVectorStore(MemoryVectorStore):
         super().__init__(dim, metric, persist_dir=persist_dir)
 
     def _on_update(self) -> None:
+        """Lock held (see MemoryVectorStore._on_update)."""
         self._dirty = True
 
     def delete_documents(self, filenames: Sequence[str]) -> int:
@@ -442,6 +458,9 @@ class TPUVectorStore(MemoryVectorStore):
         return vecs
 
     def _refresh(self) -> None:
+        """Lock held (called from the search paths inside the store
+        lock); everything here must stay cheap — slow (re)builds go
+        through the off-lock trainer."""
         if not self._dirty:
             return
         wants_ivf = (self.index_type == "ivf"
@@ -471,6 +490,7 @@ class TPUVectorStore(MemoryVectorStore):
         self._dirty = False
 
     def _refresh_flat(self) -> None:
+        """Lock held (only _refresh calls this)."""
         import jax.numpy as jnp
 
         vecs = self._normalized(self._vecs)
@@ -552,6 +572,14 @@ class TPUVectorStore(MemoryVectorStore):
         def run():
             try:
                 self._maybe_train_ivf()
+            except Exception:
+                # The trainer thread has no caller: a crash here would
+                # vanish and searches would silently stay on the exact
+                # fallback forever. Log + count; the next search
+                # re-kicks training.
+                _LOG.exception("background IVF training failed")
+                with self._slow_lock:
+                    self._bg_errors += 1
             finally:
                 with self._slow_lock:
                     self._train_busy = False
@@ -660,10 +688,10 @@ class TPUVectorStore(MemoryVectorStore):
         """One device dispatch for [Q, D] queries -> (scores [Q,k],
         ids [Q,k]) host arrays; updates the ANN counters (`n_valid`
         caps them at the real caller queries when the batch carries
-        shape padding). Every RECALL_SAMPLE_EVERYth query queues a
-        recall sample the caller runs AFTER releasing the lock (the
-        exact reference scan is O(N*D) on the host and must not block
-        concurrent searches)."""
+        shape padding). Lock held — every RECALL_SAMPLE_EVERYth query
+        queues a recall sample the caller runs AFTER releasing the
+        lock (the exact reference scan is O(N*D) on the host and must
+        not block concurrent searches)."""
         nv = n_valid if n_valid is not None else len(qs)
         if self._ivf is not None:
             scores, idx, scanned = self._ivf.search(qs, k)
@@ -685,11 +713,13 @@ class TPUVectorStore(MemoryVectorStore):
         return np.asarray(scores), np.asarray(idx)
 
     def _pop_pending_sample(self):
+        """Lock held (search paths pop before releasing the lock)."""
         sample = getattr(self, "_pending_sample", None)
         self._pending_sample = None
         return sample
 
     def _pop_pending_sidecar(self):
+        """Lock held (search paths pop before releasing the lock)."""
         state = getattr(self, "_pending_sidecar", None)
         self._pending_sidecar = None
         return state
@@ -851,13 +881,20 @@ class TPUVectorStore(MemoryVectorStore):
                         self._slow_busy = False
                         return
                 sample = None  # only the latched sidecar remains
-        except BaseException:
+        except BaseException as e:
             with self._slow_lock:
                 self._slow_busy = False
                 # Drop the latch too: keeping it would let a future
                 # worker write this now-stale sidecar over a newer one.
                 self._slow_next_sidecar = None
-            raise
+                if isinstance(e, Exception):
+                    self._bg_errors += 1
+            if not isinstance(e, Exception):
+                raise  # KeyboardInterrupt/SystemExit: never swallow
+            # Counted above, logged here: re-raising alone would only
+            # reach threading's excepthook — no counter, easy to miss.
+            _LOG.exception("vectorstore slow worker failed "
+                           "(recall sample / sidecar write dropped)")
 
     # -- observability -----------------------------------------------------
 
@@ -877,6 +914,7 @@ class TPUVectorStore(MemoryVectorStore):
                 "ann_recall_est": (round(self._recall_sum / self._recall_n, 4)
                                    if self._recall_n else None),
                 "index_rebuilds": self._rebuilds,
+                "background_errors": self._bg_errors,
             })
             return out
 
@@ -895,6 +933,7 @@ class TPUVectorStore(MemoryVectorStore):
         self._dump_ivf_state(path, self._ivf.state())
 
     def _load_extra(self, path: str) -> None:
+        """Lock held (called from _load_from inside the store lock)."""
         ip = os.path.join(path, "ivf.npz")
         if self.index_type != "ivf" or not os.path.isfile(ip):
             return

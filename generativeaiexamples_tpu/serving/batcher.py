@@ -55,6 +55,7 @@ class MicroBatchStats:
         self._lock = threading.Lock()
         self.submitted = 0
         self.dispatches = 0
+        self.dispatch_errors = 0    # dispatches whose fn raised
         self.coalesced_sum = 0      # requests that rode SOME dispatch
         self.max_coalesced = 0
         self._wait_ms: deque = deque(maxlen=self.WAIT_WINDOW)
@@ -63,9 +64,12 @@ class MicroBatchStats:
         with self._lock:
             self.submitted += n
 
-    def note_dispatch(self, batch_size: int, waits_ms: Sequence[float]) -> None:
+    def note_dispatch(self, batch_size: int, waits_ms: Sequence[float],
+                      error: bool = False) -> None:
         with self._lock:
             self.dispatches += 1
+            if error:
+                self.dispatch_errors += 1
             self.coalesced_sum += batch_size
             self.max_coalesced = max(self.max_coalesced, batch_size)
             self._wait_ms.extend(waits_ms)
@@ -78,6 +82,11 @@ class MicroBatchStats:
             return {
                 "submitted": self.submitted,
                 "dispatches": self.dispatches,
+                # Dispatches whose fn raised: the error fans out to the
+                # waiting callers, but /metrics must show it too — a
+                # rising count here with green caller stats means
+                # callers are retrying around a sick device path.
+                "dispatch_errors": self.dispatch_errors,
                 # Device launches avoided vs. the serialize-everything
                 # baseline (one dispatch per caller).
                 "dispatches_saved": self.coalesced_sum - self.dispatches,
@@ -225,7 +234,8 @@ class MicroBatcher:
             error = None
         # Record BEFORE waking waiters: a caller that reads stats right
         # after its result lands must see this dispatch counted.
-        self.stats.note_dispatch(len(group), waits_ms)
+        self.stats.note_dispatch(len(group), waits_ms,
+                                 error=error is not None)
         for i, r in enumerate(group):
             if error is not None:
                 r.error = error

@@ -1993,5 +1993,10 @@ class LLMEngine:
                     if key in stats:
                         slot.span.set_attribute(f"device.{key}", stats[key])
             except Exception:
-                pass
+                # Best-effort span enrichment (some backends expose no
+                # memory_stats) — but never silently: this runs on the
+                # scheduler thread, where a swallowed error pattern
+                # would also hide real regressions.
+                _LOG.debug("device memory_stats unavailable for span",
+                           exc_info=True)
             slot.span.end()

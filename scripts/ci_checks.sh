@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Repo check pipeline: everything a PR must pass, in the order a human
+# wants failures reported. Run from anywhere; works on the CPU backend.
+#
+#   scripts/ci_checks.sh            # lint + drift + tier-1 tests
+#   scripts/ci_checks.sh --fast     # skip the pytest step (lint only)
+#
+# Steps:
+#   1. graftlint  — JAX-serving-aware static analysis (trace purity,
+#                   lock discipline, thread hygiene, host-sync, config
+#                   drift); zero non-baselined findings required.
+#   2. ruff       — generic pycodestyle/pyflakes/bugbear subset
+#                   (pyproject.toml [tool.ruff]); skipped with a notice
+#                   when ruff isn't installed in the image.
+#   3. config-docs drift — docs/configuration.md must match
+#                   config/schema.py (scripts/gen_config_docs.py --check).
+#   4. tier-1 tests — the ROADMAP.md pytest gate.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+step() { echo; echo "== $* =="; }
+
+step "graftlint (python -m generativeaiexamples_tpu.lint)"
+python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/ || fail=1
+
+step "ruff (scripts/lint.py --ruff; skips when absent)"
+if command -v ruff >/dev/null 2>&1; then
+    ruff check generativeaiexamples_tpu/ scripts/ tests/ bench.py || fail=1
+else
+    echo "ruff not installed — skipping"
+fi
+
+step "config docs drift (scripts/gen_config_docs.py --check)"
+python scripts/gen_config_docs.py --check || fail=1
+
+if [ "${1:-}" != "--fast" ]; then
+    step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider || fail=1
+fi
+
+echo
+if [ "$fail" -ne 0 ]; then
+    echo "ci_checks: FAILED (one or more steps above)"
+else
+    echo "ci_checks: all steps passed"
+fi
+exit "$fail"

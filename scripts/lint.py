@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Repo lint entry point: graftlint + (optionally) ruff.
+
+    python scripts/lint.py               # graftlint over the package
+    python scripts/lint.py --ruff        # ... plus ruff, when installed
+    python scripts/lint.py path/ --select GL201   # args forwarded
+
+graftlint (generativeaiexamples_tpu/lint/) is the JAX-serving-aware
+pass: trace purity, lock discipline, thread hygiene, host-sync,
+config drift — see docs/static_analysis.md. ruff covers the generic
+pycodestyle/pyflakes/bugbear subset configured in pyproject.toml; the
+container doesn't ship it, so `--ruff` skips gracefully (exit 0 for
+that step) when it is not importable/runnable.
+
+Exit code: nonzero when any executed step found problems (graftlint's
+0/1/2 contract is preserved when ruff is skipped or clean).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_PATHS = [os.path.join(REPO, "generativeaiexamples_tpu")]
+
+
+def run_ruff(paths) -> int:
+    exe = shutil.which("ruff")
+    if exe is None:
+        print("lint.py: ruff not installed — skipping the ruff step "
+              "(config lives in pyproject.toml [tool.ruff])")
+        return 0
+    print(f"lint.py: running ruff check ({exe})")
+    proc = subprocess.run([exe, "check", *paths], cwd=REPO)
+    return proc.returncode
+
+
+VALUE_FLAGS = {"--select", "--ignore", "--baseline", "--write-baseline",
+               "--min-severity", "--format"}
+
+
+def positional_paths(args):
+    """Path operands among forwarded CLI args (flag values excluded)."""
+    paths, skip = [], False
+    for a in args:
+        if skip:
+            skip = False
+            continue
+        if a in VALUE_FLAGS:
+            skip = True
+        elif not a.startswith("-"):
+            paths.append(a)
+    return paths
+
+
+def main(argv) -> int:
+    args = list(argv)
+    want_ruff = "--ruff" in args
+    if want_ruff:
+        args.remove("--ruff")
+    paths = positional_paths(args)
+    if not paths:
+        args = args + DEFAULT_PATHS
+        paths = DEFAULT_PATHS
+
+    from generativeaiexamples_tpu.lint.cli import main as lint_main
+
+    rc = lint_main(args)
+    if want_ruff:
+        ruff_rc = run_ruff(paths)
+        rc = rc or ruff_rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

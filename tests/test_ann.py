@@ -114,6 +114,28 @@ class TestIVFRecall:
 
 
 class TestIVFLifecycle:
+    def test_background_trainer_failure_is_counted(self):
+        """A crash on the daemon trainer thread must not vanish: it is
+        logged AND surfaces in stats()['background_errors'] so /metrics
+        shows why searches are stuck on the exact fallback."""
+        import time
+
+        store = _ivf_store(_clustered(512))
+        assert store.stats()["background_errors"] == 0
+        store._maybe_train_ivf = lambda: (_ for _ in ()).throw(
+            RuntimeError("trainer boom"))
+        store._kick_training_async()
+        deadline = time.time() + 5
+        # The counter lands (except block) before _train_busy resets
+        # (finally block) — poll for BOTH so the assert can't race the
+        # trainer thread between the two.
+        while (store.stats()["background_errors"] == 0
+               or store._train_busy) and time.time() < deadline:
+            time.sleep(0.01)
+        assert store.stats()["background_errors"] == 1
+        # single-flight state released: a later kick may run again
+        assert store._train_busy is False
+
     def test_add_after_train_assigns_without_rebuild(self):
         vecs = _clustered(2048)
         store = _ivf_store(vecs)
@@ -413,7 +435,7 @@ class TestMetricsSurface:
                 st = body["vector_store"]
                 for key in ("index", "ntotal", "searches", "ann_probes",
                             "ann_scanned_rows", "ann_recall_est",
-                            "index_rebuilds"):
+                            "index_rebuilds", "background_errors"):
                     assert key in st
             finally:
                 await client.close()

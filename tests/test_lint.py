@@ -1,0 +1,584 @@
+"""graftlint gate: every check fires on its seeded-violation fixture,
+stays quiet on the clean counterpart, the baseline/suppression
+machinery round-trips, the CLI honors its exit-code contract, and the
+shipped tree has zero non-baselined findings.
+
+Pure AST work — nothing here imports jax or touches a device, so the
+whole module runs in milliseconds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from generativeaiexamples_tpu.lint import Baseline, lint_paths
+from generativeaiexamples_tpu.lint.cli import UsageError, resolve_checks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "generativeaiexamples_tpu")
+CLI = [sys.executable, "-m", "generativeaiexamples_tpu.lint"]
+
+
+def write_tree(root, files):
+    for rel, src in files.items():
+        path = os.path.join(str(root), rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(textwrap.dedent(src))
+    return str(root)
+
+
+def ids_of(findings):
+    return {f.check for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# fixtures: one seeded-violation + one minimal clean file per check
+# ---------------------------------------------------------------------------
+
+TRACE_BAD = """\
+    import functools
+
+    import jax
+    import numpy as np
+
+
+    @functools.partial(jax.jit, static_argnames=("flag",))
+    def step(x, flag):
+        if flag:            # static arg: fine
+            x = x + 1
+        if x > 0:           # traced condition
+            x = x * 2
+        v = x.item()        # host sync
+        f = float(x)        # concretization
+        a = np.asarray(x)   # host materialization
+        return x, v, f, a
+
+
+    peek = jax.jit(lambda p: p.item())  # jit-wrapped lambda host sync
+"""
+
+TRACE_CLEAN = """\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @functools.partial(jax.jit, static_argnames=("flag",))
+    def step(x, flag, y=None):
+        if flag:                 # static arg
+            x = x + 1
+        if y is None:            # identity test: concrete at trace
+            y = jnp.zeros_like(x)
+        if x.ndim > 1:           # shape metadata: concrete at trace
+            x = x.reshape(-1)
+        x = jnp.where(x > 0, x * 2, x)
+        return x + y + float(1.5)   # literal coercion: fine
+
+
+    def host_side(x):
+        return float(np.asarray(x).sum())  # not jitted: fine
+"""
+
+LOCK_BAD = """\
+    import threading
+
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            self._n = 0  # bare write to a lock-guarded attribute
+"""
+
+LOCK_CLEAN = """\
+    import threading
+
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            with self._lock:
+                self._clear()
+
+        def _clear(self):
+            \"\"\"Lock held (callers own self._lock).\"\"\"
+            self._n = 0
+"""
+
+THREAD_BAD = """\
+    import threading
+
+
+    class Worker:
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                try:
+                    self._work()
+                except Exception:
+                    pass
+
+        def _work(self):
+            raise ValueError("boom")
+"""
+
+THREAD_CLEAN = """\
+    import logging
+    import threading
+
+    _LOG = logging.getLogger(__name__)
+
+
+    class Worker:
+        def start(self):
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                try:
+                    self._work()
+                except ValueError:
+                    return  # narrow catch: not the broad-swallow shape
+                except Exception:
+                    _LOG.exception("worker failed")
+
+        def _work(self):
+            raise ValueError("boom")
+"""
+
+HOT_BAD = """\
+    import jax
+    import numpy as np
+
+
+    class Engine:
+        def _step(self):  # graftlint: hot-path
+            jax.block_until_ready(self._tokens)
+            got = jax.device_get(self._tokens)
+            out = np.asarray(self._tokens)
+            return got, out
+"""
+
+HOT_CLEAN = """\
+    import jax
+    import numpy as np
+
+
+    class Engine:
+        def warmup(self):  # not a hot path: syncs are fine here
+            jax.block_until_ready(self._tokens)
+            return np.asarray(self._tokens)
+
+        def _step(self):  # graftlint: hot-path
+            return self._dispatch()  # async dispatch only
+"""
+
+CONFIG_SCHEMA = """\
+    from dataclasses import dataclass, field
+
+
+    @dataclass(frozen=True)
+    class FooConfig:
+        alpha: int = 1
+        beta: str = ""
+
+
+    @dataclass(frozen=True)
+    class AppConfig:
+        foo: FooConfig = field(default_factory=FooConfig)
+"""
+
+CONFIG_DOCS_FULL = """\
+    # Configuration reference
+
+    ## `foo`
+
+    | field | default | env var |
+    |---|---|---|
+    | `alpha` | `1` | `APP_FOO_ALPHA` |
+    | `beta` | `""` | `APP_FOO_BETA` |
+"""
+
+CONFIG_DOCS_MISSING_BETA = """\
+    # Configuration reference
+
+    ## `foo`
+
+    | field | default | env var |
+    |---|---|---|
+    | `alpha` | `1` | `APP_FOO_ALPHA` |
+"""
+
+CONFIG_APP_BAD = """\
+    import os
+
+
+    def use(cfg):
+        a = getattr(cfg, "alpha", None)        # resolves: fine
+        g = getattr(cfg, "gamma", None)        # no such knob
+        v = os.environ.get("APP_FOO_NOPE")     # no such env name
+        return a, g, v
+"""
+
+CONFIG_APP_CLEAN = """\
+    import os
+
+
+    def use(cfg):
+        a = getattr(cfg, "alpha", None)
+        section = getattr(cfg, "foo", None)
+        v = os.environ.get("APP_FOO_BETA")
+        w = os.environ.get("APP_CONFIG_FILE")  # whitelisted loader knob
+        return a, section, v, w
+"""
+
+
+# ---------------------------------------------------------------------------
+# per-check detection
+# ---------------------------------------------------------------------------
+
+
+class TestTracePurity:
+    def test_fires_on_seeded_violations(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": TRACE_BAD})])
+        gl101 = [f for f in findings if f.check == "GL101"]
+        # traced if + .item() + float() + np.asarray + lambda .item()
+        assert len(gl101) == 5
+        msgs = " ".join(f.message for f in gl101)
+        assert ".item()" in msgs
+        assert "float()" in msgs
+        assert "np.asarray" in msgs
+        assert "`if`" in msgs
+
+    def test_quiet_on_clean(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": TRACE_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestLockDiscipline:
+    def test_fires_on_bare_write(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": LOCK_BAD})])
+        gl201 = [f for f in findings if f.check == "GL201"]
+        assert len(gl201) == 1
+        assert "_n" in gl201[0].message
+        assert "reset" in gl201[0].message
+
+    def test_quiet_on_clean_and_lock_held_doc(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": LOCK_CLEAN})])
+        assert ids_of(findings) == set()
+
+    def test_init_writes_exempt(self, tmp_path):
+        # __init__ seeds attributes bare by design — never a finding.
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": LOCK_BAD})])
+        assert all(f.line != 7 for f in findings)
+
+
+class TestThreadHygiene:
+    def test_fires_on_non_daemon_and_swallow(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": THREAD_BAD})])
+        assert "GL301" in ids_of(findings)
+        assert "GL302" in ids_of(findings)
+        gl302 = [f for f in findings if f.check == "GL302"]
+        assert "Worker._loop" in gl302[0].message
+
+    def test_quiet_on_clean(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": THREAD_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestHostSync:
+    def test_fires_in_marked_hot_path(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": HOT_BAD})])
+        gl401 = [f for f in findings if f.check == "GL401"]
+        assert len(gl401) == 3  # block_until_ready + device_get + asarray
+
+    def test_engine_module_defaults_apply(self, tmp_path):
+        # In a file named engine.py the known scheduler functions are
+        # hot without any marker.
+        src = HOT_BAD.replace("def _step(self):  # graftlint: hot-path",
+                              "def _dispatch_decode(self):")
+        findings = lint_paths([write_tree(tmp_path, {"engine.py": src})])
+        assert "GL401" in ids_of(findings)
+
+    def test_quiet_outside_hot_path(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": HOT_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestConfigDrift:
+    def test_fires_on_all_three_drift_shapes(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/config/schema.py": CONFIG_SCHEMA,
+            "pkg/app.py": CONFIG_APP_BAD,
+            "docs/configuration.md": CONFIG_DOCS_MISSING_BETA,
+        })
+        findings = lint_paths([root])
+        assert {"GL501", "GL502", "GL503"} <= ids_of(findings)
+        by = {f.check: f for f in findings}
+        assert "foo.beta" in by["GL501"].message
+        assert "gamma" in by["GL502"].message
+        assert "APP_FOO_NOPE" in by["GL503"].message
+
+    def test_quiet_when_in_sync(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/config/schema.py": CONFIG_SCHEMA,
+            "pkg/app.py": CONFIG_APP_CLEAN,
+            "docs/configuration.md": CONFIG_DOCS_FULL,
+        })
+        assert ids_of(lint_paths([root])) == set()
+
+    def test_inactive_without_schema(self, tmp_path):
+        # Linting a subtree that doesn't include config/schema.py must
+        # not fail on unresolvable knob references.
+        root = write_tree(tmp_path, {"pkg/app.py": CONFIG_APP_BAD})
+        assert ids_of(lint_paths([root])) == set()
+
+
+# ---------------------------------------------------------------------------
+# findings / baseline machinery
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    def test_inline_ignore_on_finding_line(self, tmp_path):
+        src = LOCK_BAD.replace(
+            "self._n = 0  # bare write to a lock-guarded attribute",
+            "self._n = 0  # graftlint: ignore[GL201]")
+        assert ids_of(lint_paths([write_tree(tmp_path,
+                                             {"mod.py": src})])) == set()
+
+    def test_inline_ignore_on_def_line_covers_function(self, tmp_path):
+        src = LOCK_BAD.replace("def reset(self):",
+                               "def reset(self):  # graftlint: ignore[GL201]")
+        assert ids_of(lint_paths([write_tree(tmp_path,
+                                             {"mod.py": src})])) == set()
+
+    def test_inline_ignore_wrong_id_keeps_finding(self, tmp_path):
+        src = LOCK_BAD.replace(
+            "self._n = 0  # bare write to a lock-guarded attribute",
+            "self._n = 0  # graftlint: ignore[GL999]")
+        assert "GL201" in ids_of(
+            lint_paths([write_tree(tmp_path, {"mod.py": src})]))
+
+
+class TestBaseline:
+    def test_roundtrip_suppresses(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        assert findings
+        bl = Baseline.from_findings(findings)
+        assert bl.filter(findings) == []
+        assert bl.unused_entries() == []
+
+    def test_save_load_roundtrip(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        path = str(tmp_path / "baseline.json")
+        Baseline.from_findings(findings).save(path)
+        bl = Baseline.load(path)
+        assert bl.filter(findings) == []
+        data = json.load(open(path))
+        assert data["version"] == 1
+        assert all({"check", "file", "line", "hash", "reason"}
+                   <= set(e) for e in data["entries"])
+
+    def test_line_drift_tolerated(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        bl = Baseline.from_findings(findings)
+        # Same code, pushed 7 lines down: hash matching still holds.
+        drifted = "# pad\n" * 7 + textwrap.dedent(LOCK_BAD)
+        f2 = lint_paths([write_tree(tmp_path / "b", {"mod.py": drifted})])
+        assert f2 and f2[0].line != findings[0].line
+        assert bl.filter(f2) == []
+
+    def test_file_move_tolerated(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        bl = Baseline.from_findings(findings)
+        f2 = lint_paths([write_tree(tmp_path / "b",
+                                    {"moved/renamed.py": LOCK_BAD})])
+        assert f2 and f2[0].path != findings[0].path
+        assert bl.filter(f2) == []
+
+    def test_edited_line_invalidates(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        bl = Baseline.from_findings(findings)
+        edited = LOCK_BAD.replace("self._n = 0  #", "self._n = 1  #")
+        f2 = lint_paths([write_tree(tmp_path / "b", {"mod.py": edited})])
+        assert f2 and bl.filter(f2) == f2  # suppression no longer applies
+
+    def test_regenerate_preserves_curated_reasons(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        bl = Baseline.from_findings(findings)
+        bl.entries[0]["reason"] = "carefully justified"
+        regen = Baseline.from_findings(findings, previous=Baseline(
+            bl.entries))
+        assert regen.entries[0]["reason"] == "carefully justified"
+
+    def test_stale_entries_reported(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path / "a",
+                                          {"mod.py": LOCK_BAD})])
+        bl = Baseline.from_findings(findings)
+        clean = lint_paths([write_tree(tmp_path / "b",
+                                       {"mod.py": LOCK_CLEAN})])
+        assert bl.filter(clean) == []
+        assert len(bl.unused_entries()) == len(bl)
+
+
+class TestSeverityAndSelection:
+    def test_min_severity_filters_warnings(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        assert "GL201" in ids_of(lint_paths([root]))
+        assert ids_of(lint_paths([root], min_severity="error")) == set()
+
+    def test_select_and_ignore(self, tmp_path):
+        root = write_tree(tmp_path, {"lk.py": LOCK_BAD,
+                                     "tr.py": TRACE_BAD})
+        only = lint_paths([root], select=["GL101"])
+        assert ids_of(only) == {"GL101"}
+        rest = lint_paths([root], ignore=["GL101"])
+        assert "GL101" not in ids_of(rest)
+        assert "GL201" in ids_of(rest)
+
+    def test_unknown_check_id_rejected(self):
+        with pytest.raises(UsageError):
+            resolve_checks(["GL999"], None)
+
+    def test_syntax_error_surfaces_as_finding(self, tmp_path):
+        root = write_tree(tmp_path, {"broken.py": "def f(:\n"})
+        findings = lint_paths([root])
+        assert ids_of(findings) == {"GL000"}
+
+
+# ---------------------------------------------------------------------------
+# CLI exit-code contract: 0 clean, 1 findings, 2 usage error
+# ---------------------------------------------------------------------------
+
+
+def run_cli(*args):
+    return subprocess.run(CLI + list(args), cwd=REPO, text=True,
+                          capture_output=True, timeout=120)
+
+
+class TestCLI:
+    def test_exit_0_on_clean_tree(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": TRACE_CLEAN})
+        proc = run_cli(root, "--no-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_exit_1_on_findings(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": TRACE_BAD})
+        proc = run_cli(root, "--no-baseline")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "GL101" in proc.stdout
+
+    @pytest.mark.parametrize("check_id,files", [
+        ("GL101", {"mod.py": TRACE_BAD}),
+        ("GL201", {"mod.py": LOCK_BAD}),
+        ("GL301", {"mod.py": THREAD_BAD}),
+        ("GL302", {"mod.py": THREAD_BAD}),
+        ("GL401", {"mod.py": HOT_BAD}),
+        ("GL501", {"pkg/config/schema.py": CONFIG_SCHEMA,
+                   "pkg/app.py": CONFIG_APP_BAD,
+                   "docs/configuration.md": CONFIG_DOCS_MISSING_BETA}),
+    ])
+    def test_exit_1_per_seeded_fixture(self, tmp_path, check_id, files):
+        root = write_tree(tmp_path, files)
+        proc = run_cli(root, "--no-baseline")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert check_id in proc.stdout
+
+    def test_exit_2_on_bad_flag(self):
+        assert run_cli("--definitely-not-a-flag").returncode == 2
+
+    def test_exit_2_on_missing_path(self):
+        proc = run_cli("/nonexistent/path/xyz")
+        assert proc.returncode == 2
+        assert "does not exist" in proc.stderr
+
+    def test_exit_2_on_no_paths(self):
+        assert run_cli().returncode == 2
+
+    def test_exit_2_on_unknown_select(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": TRACE_CLEAN})
+        proc = run_cli(root, "--select", "GL999")
+        assert proc.returncode == 2
+        assert "unknown check" in proc.stderr
+
+    def test_list_checks(self):
+        proc = run_cli("--list-checks")
+        assert proc.returncode == 0
+        for cid in ("GL101", "GL201", "GL301", "GL302", "GL401", "GL501"):
+            assert cid in proc.stdout
+
+    def test_json_format(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        proc = run_cli(root, "--no-baseline", "--format", "json")
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload[0]["check"] == "GL201"
+        assert payload[0]["hash"]
+
+    def test_write_baseline_then_clean(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        bl_path = str(tmp_path / "bl.json")
+        assert run_cli(root, "--write-baseline", bl_path).returncode == 0
+        proc = run_cli(root, "--baseline", bl_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "1 baselined" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree itself
+# ---------------------------------------------------------------------------
+
+
+class TestShippedTree:
+    def test_package_has_zero_nonbaselined_findings(self):
+        bl_path = os.path.join(REPO, "lint-baseline.json")
+        baseline = Baseline.load(bl_path) if os.path.isfile(bl_path) \
+            else None
+        findings = lint_paths([PKG], baseline=baseline)
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_checked_in_baseline_entries_all_have_reasons(self):
+        bl_path = os.path.join(REPO, "lint-baseline.json")
+        if not os.path.isfile(bl_path):
+            pytest.skip("no baseline checked in")
+        bl = Baseline.load(bl_path)
+        for e in bl.entries:
+            assert e.get("reason", "").strip(), e
+            assert "justify or fix" not in e["reason"], (
+                "placeholder reason left in the checked-in baseline")
+
+    def test_cli_exit_0_on_shipped_tree(self):
+        proc = run_cli("generativeaiexamples_tpu/")
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -106,6 +106,15 @@ class TestMicroBatcher:
         b = MicroBatcher("t", fn, max_batch=16, max_wait_us=WAIT_US)
         with pytest.raises(RuntimeError, match="boom"):
             _hammer(4, lambda i: b.submit(i))
+        # The failure is also COUNTED, not just fanned out: /metrics
+        # must show a sick dispatch path even when callers retry.
+        snap = b.stats.snapshot()
+        assert snap["dispatch_errors"] == snap["dispatches"] > 0
+
+    def test_clean_dispatches_count_no_errors(self):
+        b = MicroBatcher("t", lambda xs: xs, max_batch=4, max_wait_us=0)
+        assert b.submit("x") == "x"
+        assert b.stats.snapshot()["dispatch_errors"] == 0
 
     def test_result_length_mismatch_is_an_error(self):
         b = MicroBatcher("t", lambda xs: [1], max_batch=8,
