@@ -14,6 +14,7 @@ reference publishes no numbers — BASELINE.md).
 Env knobs: BENCH_MODEL (8b|1b|tiny), BENCH_BATCH, BENCH_PROMPT,
 BENCH_GEN, BENCH_PAGE, BENCH_QUANT (0|1), BENCH_KV_DTYPE, BENCH_SPEC,
 BENCH_K, BENCH_PIPELINE, BENCH_DEVICE_INIT, BENCH_LONGCTX (0 skips),
+BENCH_FUSED (0 skips),
 BENCH_PREFIX (0 skips), BENCH_ENCODERS (0 skips), BENCH_ANN (0 skips;
 BENCH_ANN_N / _DIM / _NLIST / _NPROBE tune the corpus and index),
 BENCH_CONCURRENT (0 skips; BENCH_CONCURRENT_THREADS / _REQS / _N
@@ -23,6 +24,14 @@ Scenario output keys (under "extras"):
   long-context:  ttft_prompt2k_ms, ttft_prompt8k_ms,
                  prefill_tok_per_sec_{2k,8k}, ttft_8k_under_load_ms,
                  short_stream_gap_p95_{before,during_8k_prefill}_ms
+  fused dispatch: fused_gap_p95_during_8k_prefill_ms,
+                 fused_vs_unfused_gap_ratio, fused_ttft_8k_under_load_ms,
+                 fused_gap_p95_before_ms, fused_steps,
+                 fused_prefill_tokens, prefill_stall_beats (the same
+                 8k-prefill-under-load workload as long-context with
+                 engine.fused_prefill on — prefill chunks ride inside
+                 decode dispatches, serving/engine_model.py
+                 fused_decode_prefill_step; BENCH_FUSED=0 skips)
   prefix cache:  prefix_ttft_cold_ms, prefix_ttft_warm_ms,
                  prefix_warm_speedup, prefix_hits, prefix_miss,
                  prefix_hit_tokens (warm-prefix vs cold TTFT through
@@ -47,7 +56,8 @@ Scenario output keys (under "extras"):
 `python bench.py --help` prints this header and exits.
 
 Sibling tooling (same checkout):
-  scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_microbatch.py
+  scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_microbatch.py /
+  smoke_fused_step.py
       targeted CPU smoke gates for the serving subsystems
   python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/
       graftlint static analysis (trace purity, lock discipline, thread
@@ -312,6 +322,21 @@ def main() -> None:
         except Exception as e:
             longctx_stats = {"longctx_error": f"{type(e).__name__}: {e}"}
 
+    # -- fused prefill+decode dispatch (ISSUE 5 tentpole): the same
+    # 8k-prefill-under-load workload as the longctx scenario, with
+    # engine.fused_prefill on — prefill chunks ride inside decode
+    # dispatches instead of serializing ahead of them.
+    fused_stats = {}
+    if os.environ.get("BENCH_FUSED", "1") != "0":
+        import gc
+
+        eng = None
+        gc.collect()
+        try:
+            fused_stats = _bench_fused(params, cfg, longctx_stats)
+        except Exception as e:
+            fused_stats = {"fused_error": f"{type(e).__name__}: {e}"}
+
     # -- prefix cache: warm-prefix vs cold TTFT (the RAG serving shape
     # — identical system prompt + replayed context; ISSUE 1 tentpole).
     prefix_stats = {}
@@ -396,6 +421,7 @@ def main() -> None:
                 "expected to read slightly above the headline"),
             "backend": jax.default_backend(),
             **longctx_stats,
+            **fused_stats,
             **prefix_stats,
             **encoder_stats,
             **ann_stats,
@@ -405,51 +431,43 @@ def main() -> None:
     print(json.dumps(out))
 
 
-def _bench_longctx(params, cfg):
-    """Long-context serving on the real chip: chunked-prefill TTFT at
-    2k and 8k prompts, prefill throughput, and inter-token cadence of
-    live short streams while an 8k prefill is in progress (the
-    one-chunk-per-landed-block pacing claim, engine.py _LongPrefill)."""
-    import gc
-    import threading
+def _p95_ms(v):
+    return round(sorted(v)[int(0.95 * (len(v) - 1))] * 1e3, 1) if v \
+        else None
 
+
+def _longctx_engine(params, cfg, warm_lengths, tag, **overrides):
+    """The shared long-context serving config (8k pool, 1024-token
+    chunks, int8 KV). _bench_longctx and _bench_fused must measure the
+    IDENTICAL workload on the identical engine geometry — the
+    fused_vs_unfused_gap_ratio is meaningless otherwise — so both build
+    through here and differ only in explicit overrides."""
     from generativeaiexamples_tpu.config.schema import EngineConfig
     from generativeaiexamples_tpu.serving.engine import LLMEngine
     from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
 
-    gc.collect()
-    if cfg.max_seq_len < 8192 or cfg.vocab_size < 1024:
-        return {"longctx_skipped":
-                f"model geometry too small (max_seq_len={cfg.max_seq_len})"}
     # 8192 = the model's rope table; prompts stop a page short so the
     # generated tokens stay in range.
     ecfg = EngineConfig(max_batch_size=8, max_seq_len=8192, page_size=128,
                         prefill_buckets=(1024,), kv_dtype="int8",
-                        decode_steps_per_dispatch=8, pipeline_depth=2)
+                        decode_steps_per_dispatch=8, pipeline_depth=2,
+                        **overrides)
     eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg)
     t0 = time.perf_counter()
-    eng.warmup(long_prompts=True, long_prompt_lengths=(2048, 8064))
+    eng.warmup(long_prompts=True, long_prompt_lengths=warm_lengths)
     eng.start()
-    print(f"[bench] longctx warmup {time.perf_counter()-t0:.1f}s",
+    print(f"[bench] {tag} warmup {time.perf_counter()-t0:.1f}s",
           file=sys.stderr)
-    stats = {}
+    return eng
 
-    def one(plen, tag):
-        prompt = [2 + (i % 1000) for i in range(plen)]
-        t0 = time.perf_counter()
-        first = None
-        for ev in eng.generate_stream(prompt, max_new_tokens=2):
-            if ev["token_id"] >= 0 and first is None:
-                first = time.perf_counter() - t0
-        stats[f"ttft_prompt{tag}_ms"] = round(first * 1e3, 1)
-        stats[f"prefill_tok_per_sec_{tag}"] = round(plen / first, 1)
 
-    one(2048, "2k")
-    one(8064, "8k")
+def _gaps_under_8k_prefill(eng):
+    """The 8k-prefill-under-load workload: 4 short streams decode
+    continuously; an 8k prefill starts mid-flight. Returns (8k TTFT
+    seconds, before-gaps, during-gaps) of the live streams' inter-token
+    cadence around the prefill window."""
+    import threading
 
-    # Pacing: 4 short streams decode continuously; an 8k prefill starts
-    # mid-flight. The claim: their token cadence degrades to at most
-    # ~one chunk-forward per block, not a multi-second freeze.
     gaps_during = []
     gaps_before = []
     window = {}
@@ -484,20 +502,86 @@ def _bench_longctx(params, cfg):
             window["end"] = time.perf_counter()
     for t in threads:
         t.join(timeout=120)
+    return first, gaps_before, gaps_during
+
+
+def _bench_longctx(params, cfg):
+    """Long-context serving on the real chip: chunked-prefill TTFT at
+    2k and 8k prompts, prefill throughput, and inter-token cadence of
+    live short streams while an 8k prefill is in progress (the
+    one-chunk-per-landed-block pacing claim, engine.py _LongPrefill)."""
+    import gc
+
+    gc.collect()
+    if cfg.max_seq_len < 8192 or cfg.vocab_size < 1024:
+        return {"longctx_skipped":
+                f"model geometry too small (max_seq_len={cfg.max_seq_len})"}
+    eng = _longctx_engine(params, cfg, (2048, 8064), "longctx")
+    stats = {}
+
+    def one(plen, tag):
+        prompt = [2 + (i % 1000) for i in range(plen)]
+        t0 = time.perf_counter()
+        first = None
+        for ev in eng.generate_stream(prompt, max_new_tokens=2):
+            if ev["token_id"] >= 0 and first is None:
+                first = time.perf_counter() - t0
+        stats[f"ttft_prompt{tag}_ms"] = round(first * 1e3, 1)
+        stats[f"prefill_tok_per_sec_{tag}"] = round(plen / first, 1)
+
+    one(2048, "2k")
+    one(8064, "8k")
+    first, gaps_before, gaps_during = _gaps_under_8k_prefill(eng)
     eng.stop()
 
-    def p95(v):
-        return round(sorted(v)[int(0.95 * (len(v) - 1))] * 1e3, 1) if v \
-            else None
-
     stats["ttft_8k_under_load_ms"] = round(first * 1e3, 1)
-    stats["short_stream_gap_p95_before_ms"] = p95(gaps_before)
-    stats["short_stream_gap_p95_during_8k_prefill_ms"] = p95(gaps_during)
+    stats["short_stream_gap_p95_before_ms"] = _p95_ms(gaps_before)
+    stats["short_stream_gap_p95_during_8k_prefill_ms"] = _p95_ms(gaps_during)
     stats["short_stream_gap_max_during_8k_prefill_ms"] = (
         round(max(gaps_during) * 1e3, 1) if gaps_during else None)
     del eng
     gc.collect()
     return stats
+
+
+def _bench_fused(params, cfg, longctx_stats):
+    """Fused prefill+decode dispatch vs the interleaved lane: the
+    IDENTICAL 8k-prefill-under-load workload as _bench_longctx
+    (_gaps_under_8k_prefill on the _longctx_engine geometry) with
+    engine.fused_prefill on. Reports the live short streams' inter-
+    token gap p95 while the 8k prefill is in flight, the 8k TTFT under
+    load, and the ratio against the unfused run's gap (the ~7x stall
+    BENCH_r05 measured is the number this lane exists to close)."""
+    import gc
+
+    gc.collect()
+    if cfg.max_seq_len < 8192 or cfg.vocab_size < 1024:
+        return {"fused_skipped":
+                f"model geometry too small (max_seq_len={cfg.max_seq_len})"}
+    eng = _longctx_engine(params, cfg, (8064,), "fused",
+                          fused_prefill=True)
+    first, gaps_before, gaps_during = _gaps_under_8k_prefill(eng)
+    snap = eng.metrics.snapshot()
+    eng.stop()
+    del eng
+    gc.collect()
+
+    unfused_gap = longctx_stats.get(
+        "short_stream_gap_p95_during_8k_prefill_ms")
+    fused_gap = _p95_ms(gaps_during)
+    return {
+        "fused_ttft_8k_under_load_ms": round(first * 1e3, 1),
+        "fused_gap_p95_before_ms": _p95_ms(gaps_before),
+        "fused_gap_p95_during_8k_prefill_ms": fused_gap,
+        "fused_gap_max_during_8k_prefill_ms": (
+            round(max(gaps_during) * 1e3, 1) if gaps_during else None),
+        "fused_vs_unfused_gap_ratio": (
+            round(fused_gap / unfused_gap, 3)
+            if fused_gap and unfused_gap else None),
+        "fused_steps": snap["fused_steps"],
+        "fused_prefill_tokens": snap["fused_prefill_tokens"],
+        "prefill_stall_beats": snap["prefill_stall_beats"],
+    }
 
 
 def _bench_prefix_cache(params, cfg):

@@ -238,6 +238,23 @@ class EngineConfig:
     # at a fine grain (8k-under-load TTFT ~2 s instead of 3.4 s) while
     # the pacer keeps live-stream cadence smooth. 0 = no cap.
     prefill_decode_k_cap: int = 2
+    # Fused prefill+decode dispatch (the Sarathi-Serve chunked-fusion
+    # role): while live streams are decoding, an in-progress chunked
+    # prefill's next chunk rides INSIDE the decode dispatch — one
+    # jitted step computes the decode block AND up to
+    # fused_token_budget prompt tokens against the prefill's scratch
+    # cache, so long prompts advance without standalone batch-of-1
+    # chunk dispatches serializing ahead of decode blocks on the
+    # device queue. Falls back to the interleaved lane when the engine
+    # is idle, the engine is speculative, or the fused variant isn't
+    # warmed. Off by default — off is byte-identical to the
+    # interleaved-lane engine.
+    fused_prefill: bool = False
+    # Per-fused-step prompt-token budget for the rider (bounds how much
+    # a decode block's latency inflates while a prefill is fused into
+    # it). The rider's chunk width is the largest power of two <=
+    # min(budget, largest prefill bucket).
+    fused_token_budget: int = 512
     # Cross-request prefix KV reuse (the RadixAttention / vLLM-APC /
     # NIM KV-reuse role, serving/prefix_cache.py): a host-side radix
     # tree maps page-granular prompt prefixes to ref-counted pool

@@ -14,7 +14,10 @@ concurrency discipline; this one is explicit):
   loop:  admit waiting requests (same-bucket admissions prefill in ONE
          batched dispatch; prompts beyond the largest bucket go through
          chunked prefill, paced one chunk per landed block while decode
-         traffic is live); keep up to pipeline_depth fused decode
+         traffic is live — or, with engine.fused_prefill, folded INTO
+         the decode dispatch as a rider so no standalone chunk program
+         ever queues ahead of a decode block); keep up to
+         pipeline_depth fused decode
          blocks in flight over ALL active slots (fixed batch shape,
          inactive slots masked to the page-0 sink, sampling on device,
          tokens chained device-side); block only on fetching the OLDEST
@@ -174,7 +177,7 @@ class _LongPrefill:
     decode traffic, chunks run at full dispatch speed."""
 
     __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot",
-                 "beat", "chunk")
+                 "beat", "chunk", "stall_pos")
 
     def __init__(self, req, slot_idx, seq, ids, cache, slot, chunk):
         self.req = req
@@ -185,6 +188,9 @@ class _LongPrefill:
         self.pos = 0  # next prompt offset to feed
         self.slot = slot  # the placeholder occupying slots[slot_idx]
         self.beat = -1  # reader beat at which the last chunk dispatched
+        # pos observed at the last beat boundary (-1 = not yet seen);
+        # drives the prefill_stall_beats counter.
+        self.stall_pos = -1
         # Chunk width per forward: the largest bucket for long prompts;
         # prefix-cache hits on short prompts use the suffix's bucket so
         # a small uncached tail never pays a full-width forward.
@@ -211,6 +217,16 @@ class EngineMetrics:
         # tokens, not bucket padding) — with the prefix cache on, a hit
         # adds only its uncached suffix here.
         self.prefill_tokens = 0
+        # Fused prefill+decode dispatch (engine.fused_prefill): decode
+        # blocks that carried a prefill chunk as a rider, real (un-
+        # padded) prompt tokens fed through riders, and scheduling
+        # beats (landed decode blocks) during which an in-progress
+        # chunked prefill advanced zero tokens — the stall the fused
+        # lane exists to close. Always present (0 when fusing is off)
+        # so dashboards never see the keys appear and disappear.
+        self.fused_steps = 0
+        self.fused_prefill_tokens = 0
+        self.prefill_stall_beats = 0
         # Prefix-cache counters (serving/prefix_cache.py): lookups that
         # adopted cached pages / that found nothing, pages LRU-evicted,
         # and prompt tokens whose prefill was skipped via the cache.
@@ -277,6 +293,9 @@ class EngineMetrics:
             "mean_batch_occupancy": occ,
             "tokens_per_sec": self.tokens_per_sec(),
             "prefill_tokens": self.prefill_tokens,
+            "fused_steps": self.fused_steps,
+            "fused_prefill_tokens": self.fused_prefill_tokens,
+            "prefill_stall_beats": self.prefill_stall_beats,
             "prefix_hits": self.prefix_hits,
             "prefix_miss": self.prefix_miss,
             "prefix_evictions": self.prefix_evictions,
@@ -447,6 +466,31 @@ class LLMEngine:
         # KVCache on device; cap how many coexist (old synchronous path
         # peak = exactly 1).
         self._max_long_prefills = 1
+        # Fused prefill+decode dispatch (engine.fused_prefill): the
+        # rider's chunk width — largest power of two within both the
+        # biggest bucket and the per-step token budget. 0 = fusing
+        # unavailable (knob off, speculative engine, or a non-positive
+        # budget); the interleaved lane then carries all chunks.
+        self._fused_width = 0
+        if (self.ecfg.fused_prefill and self._spec_k == 0
+                and self.ecfg.fused_token_budget > 0):
+            w = 1
+            while w * 2 <= min(self.buckets[-1],
+                               self.ecfg.fused_token_budget):
+                w *= 2
+            self._fused_width = w
+        # (S_total, K) fused variants precompiled by warmup(); empty
+        # means any shape may dispatch and compile on demand (CPU
+        # tests). Same contract as _warm_ks.
+        self._warm_fused: set = set()
+        # (S_total, width) chunked-prefill variants warmed for the
+        # interleaved lane — the tail chunk buckets to the smallest
+        # warmed power-of-two width instead of padding to full chunk.
+        self._warm_chunk_widths: set = set()
+        # Reusable host staging buffers for chunk dispatches, keyed by
+        # width (one np array per width for the engine's lifetime —
+        # the old path allocated a fresh (1, chunk) buffer per chunk).
+        self._chunk_staging: Dict[int, np.ndarray] = {}
         self.pipeline_depth = max(1, self.ecfg.pipeline_depth)
         # K variants precompiled by warmup(); empty (no warmup, e.g.
         # CPU tests) means any K may dispatch and compile on demand.
@@ -612,6 +656,35 @@ class LLMEngine:
                                  for s in long_prompt_lengths})
             else:
                 s_tots = list(range(chunk, self.max_pages * ps + 1, chunk))
+
+            def pow2_at_least(n: int) -> int:
+                w = 1
+                while w < n:
+                    w *= 2
+                return w
+
+            # Tail-chunk widths per scratch shape: the final partial
+            # chunk buckets to the smallest warmed power-of-two width
+            # instead of padding to the full chunk. With known serving
+            # lengths only the widths those tails need are compiled;
+            # otherwise warm the whole power-of-two ladder from
+            # page_size up (each is its own XLA variant).
+            tail_widths: Dict[int, set] = {s: set() for s in s_tots}
+            if long_prompt_lengths is not None:
+                for s in long_prompt_lengths:
+                    p = min(int(s), self.max_pages * ps)
+                    s_tot = min(-(-p // chunk) * chunk, self.max_pages * ps)
+                    r = p % chunk
+                    if r and pow2_at_least(r) < chunk:
+                        tail_widths[s_tot].add(pow2_at_least(r))
+            else:
+                ladder = set()
+                w = pow2_at_least(min(ps, chunk))
+                while w < chunk:
+                    ladder.add(w)
+                    w *= 2
+                for s_tot in s_tots:
+                    tail_widths[s_tot] = set(ladder)
             logits = None
             for s_tot in s_tots:
                 if self.prefix_cache is not None:
@@ -629,9 +702,47 @@ class LLMEngine:
                     self._put(np.zeros((1, chunk), np.int32)),
                     self._put(np.int32(1)), self.use_pallas,
                     mesh=self.mesh)
+                self._warm_chunk_widths.add((s_tot, chunk))
+                for w in sorted(tail_widths[s_tot]):
+                    logits, cache = engine_model.prefill_chunk_step(
+                        self.params, self.cfg, cache,
+                        self._put(np.zeros((1, w), np.int32)),
+                        self._put(np.int32(1)), self.use_pallas,
+                        mesh=self.mesh)
+                    self._warm_chunk_widths.add((s_tot, w))
                 self.pool = engine_model.cache_to_pool(
                     self.pool, cache, self.cfg,
                     self._put(np.zeros((s_tot // ps,), np.int32)))
+                if self._fused_width and s_tot >= self._fused_width:
+                    # Fused prefill+decode variants this scratch shape
+                    # can reach in live traffic: K is capped by
+                    # prefill_decode_k_cap whenever a long prefill is
+                    # in progress, so only those (and the always-
+                    # dispatchable K=1) need compiling.
+                    B = self.ecfg.max_batch_size
+                    cap = self.ecfg.prefill_decode_k_cap
+                    fks = sorted({k for k in ks if cap <= 0 or k <= cap}
+                                 | {1})
+                    for kf in fks:
+                        for flags in flag_sets:
+                            (_, self._last_tokens, self.pool, logits,
+                             cache) = engine_model.fused_decode_prefill_step(
+                                self.params, self.cfg, self.pool,
+                                self._last_tokens,
+                                self._put(np.zeros((B, self.max_pages),
+                                                   np.int32)),
+                                self._put(np.ones((B,), np.int32)),
+                                self._put(np.zeros((B,), bool)),
+                                self._put(np.zeros((B,), np.float32)),
+                                self._put(np.ones((B,), np.float32)),
+                                self._put(np.zeros((B,), np.int32)),
+                                key, cache,
+                                self._put(np.zeros((1, self._fused_width),
+                                                   np.int32)),
+                                self._put(np.int32(1)), kf,
+                                self.use_pallas, sampling_flags=flags,
+                                mesh=self.mesh)
+                            self._warm_fused.add((s_tot, kf))
             if logits is not None:
                 # The chunked-prefill FINISH path samples through its
                 # own jit variants (sample_token / set_last_token),
@@ -671,6 +782,7 @@ class LLMEngine:
                         self._put(np.zeros((1, chunk), np.int32)),
                         self._put(np.int32(1)), self.use_pallas,
                         mesh=self.mesh)
+                    self._warm_chunk_widths.add((s_tot, chunk))
                 self.pool = engine_model.cache_to_pool(
                     self.pool, cache, self.cfg,
                     self._put(np.zeros((s_tot // ps,), np.int32)))
@@ -843,6 +955,7 @@ class LLMEngine:
                     fl.releases = []
                 self._reap_starved()
                 self._beat += 1
+                self._note_prefill_stalls()
                 did_work = True
             elif self._pending_first:
                 # No blocks in flight but first tokens still en route
@@ -1292,7 +1405,16 @@ class LLMEngine:
     def _advance_long_prefills(self) -> bool:
         """Dispatch at most ONE chunk for each in-progress long prefill
         (paced by the reader beat while decode traffic is live); finish
-        those whose prompt is fully fed. Returns True if any advanced."""
+        those whose prompt is fully fed. Returns True if any advanced.
+
+        With engine.fused_prefill on, this is only the FALLBACK lane:
+        while decode traffic can carry the chunk as a rider inside the
+        next decode dispatch (_fuse_ready), dispatching a standalone
+        batch-of-1 chunk here would reintroduce the device-queue stall
+        the fused step removes. The lane still runs when the engine is
+        idle (chunks at full dispatch speed), when the engine is
+        speculative, when fusing is off, or when the fused variant for
+        this scratch shape isn't warmed."""
         did = False
         decoding = any(s is not None and not s.prefilling
                        for s in self.slots)
@@ -1306,6 +1428,8 @@ class LLMEngine:
                 self._long_prefills.remove(lp)
                 self._finish(lp.slot_idx, "cancelled")
                 continue
+            if decoding and self._fuse_ready(lp):
+                continue  # the next decode dispatch carries the chunk
             if decoding and lp.beat == self._beat:
                 # At most prefill_chunks_per_block chunks per LANDED
                 # decode block while other streams are live — the
@@ -1314,6 +1438,7 @@ class LLMEngine:
                 continue
             lp.beat = self._beat
             chunk = lp.chunk
+            s_total = lp.cache.k.shape[-2]
             n_chunks = max(1, self.ecfg.prefill_chunks_per_block) \
                 if decoding else 1
             try:
@@ -1321,7 +1446,9 @@ class LLMEngine:
                     part = lp.ids[lp.pos:lp.pos + chunk]
                     if not part:
                         break
-                    tok = np.zeros((1, chunk), np.int32)
+                    width = self._pick_chunk_width(len(part), chunk,
+                                                   s_total)
+                    tok = self._chunk_buf(width)
                     tok[0, :len(part)] = part
                     logits, lp.cache = engine_model.prefill_chunk_step(
                         self.params, self.cfg, lp.cache, self._put(tok),
@@ -1339,6 +1466,76 @@ class LLMEngine:
                 self._fail_request(lp.req, lp.slot_idx, lp.seq)
             did = True
         return did
+
+    def _pick_chunk_width(self, n: int, chunk: int, s_total: int) -> int:
+        """Dispatch width for a chunk of n valid tokens: the smallest
+        power of two >= n, capped at the full chunk. When ANY warmup
+        ran (_warm_ks non-empty), restricted to the widths precompiled
+        for this scratch shape, falling back to the full chunk — the
+        prompt's earlier chunks already compiled that variant, so the
+        tail never adds a cold compile that the old pad-to-full-chunk
+        path didn't have. Only a never-warmed engine (CPU tests) may
+        compile a fresh tail width on demand."""
+        w = 1
+        while w < n:
+            w *= 2
+        if w >= chunk:
+            return chunk
+        if self._warm_ks or self._warm_chunk_widths:
+            fits = sorted(x for (s, x) in self._warm_chunk_widths
+                          if s == s_total and n <= x < chunk)
+            return fits[0] if fits else chunk
+        return w
+
+    def _chunk_buf(self, width: int) -> np.ndarray:
+        """Zeroed (1, width) int32 staging buffer, reused across chunk
+        dispatches (_put copies it to the device synchronously, so the
+        host buffer is free again by the time the call returns)."""
+        buf = self._chunk_staging.get(width)
+        if buf is None:
+            buf = np.zeros((1, width), np.int32)
+            self._chunk_staging[width] = buf
+        else:
+            buf.fill(0)
+        return buf
+
+    # graftlint: hot-path
+    def _fuse_ready(self, lp: "_LongPrefill") -> bool:
+        """True when the next decode dispatch can carry this prefill's
+        chunk as a fused rider: fusing is available, the scratch cache
+        fits the rider width, the fused variant is warmed (or no warmup
+        constrains shapes), and at least one decode slot can actually
+        dispatch — without that last check, deferring would stall the
+        prefill behind traffic that never launches a block."""
+        if not self._fused_width or lp.pos >= len(lp.ids):
+            return False
+        s_total = lp.cache.k.shape[-2]
+        if s_total < self._fused_width:
+            return False
+        if self._warm_ks and not any(
+                (s_total, k) in self._warm_fused for k in self._warm_ks):
+            # A warmup ran but didn't cover this fused shape (e.g.
+            # long_prompts=False): never compile it mid-traffic — the
+            # interleaved lane carries the chunks instead.
+            return False
+        for s in self.slots:
+            if (s is not None and not s.prefilling
+                    and not s.req.cancelled and not s.no_capacity
+                    and s.req.max_new_tokens - s.scheduled > 0):
+                return True
+        return False
+
+    # graftlint: hot-path
+    def _note_prefill_stalls(self) -> None:
+        """One landed decode block = one scheduling beat; an in-progress
+        chunked prefill that advanced zero prompt tokens over the beat
+        counts one prefill_stall_beats — the generation-stall signal
+        the fused lane exists to close (and the honest residual when
+        the fallback lane is carrying the chunks)."""
+        for lp in self._long_prefills:
+            if lp.stall_pos == lp.pos:
+                self.metrics.prefill_stall_beats += 1
+            lp.stall_pos = lp.pos
 
     def _finish_long_prefill(self, lp: "_LongPrefill", logits) -> None:
         """Last chunk fed: scatter the scratch cache into the page pool,
@@ -1530,13 +1727,17 @@ class LLMEngine:
         # extra compile, ever — not one per flag combination.
         all_greedy = bool(all(temps[i] <= 0.0 for i in active))
         flags = (True, False, False) if all_greedy else (False, True, True)
-        block, self._last_tokens, self.pool = engine_model.decode_multi_step(
-            self.params, self.cfg, self.pool, self._last_tokens,
-            self._put(tables), self._put(lengths),
-            self._put(active_mask), self._put(temps),
-            self._put(top_ps), self._put(top_ks),
-            self._next_key(), K, self.use_pallas, sampling_flags=flags,
-            mesh=self.mesh)
+        block = self._dispatch_fused_rider(tables, lengths, active_mask,
+                                           temps, top_ps, top_ks, K, flags)
+        if block is None:
+            block, self._last_tokens, self.pool = \
+                engine_model.decode_multi_step(
+                    self.params, self.cfg, self.pool, self._last_tokens,
+                    self._put(tables), self._put(lengths),
+                    self._put(active_mask), self._put(temps),
+                    self._put(top_ps), self._put(top_ks),
+                    self._next_key(), K, self.use_pallas,
+                    sampling_flags=flags, mesh=self.mesh)
         metas = []
         for i in active:
             s = self.slots[i]
@@ -1556,6 +1757,62 @@ class LLMEngine:
                 pass
         self._inflight.append(_InFlight(block, metas, K))
         return True
+
+    # graftlint: hot-path
+    def _dispatch_fused_rider(self, tables, lengths, active_mask, temps,
+                              top_ps, top_ks, K: int, flags):
+        """Fused prefill+decode dispatch (engine.fused_prefill): fold
+        the next chunk of an in-progress long prefill into this decode
+        dispatch as ONE jitted step, so the prefill advances without a
+        standalone batch-of-1 program serializing ahead of decode
+        blocks on the device queue. Returns the decode block, or None
+        when no rider applies (plain decode_multi_step dispatches
+        instead — fused-off, speculative, idle-prefill and unwarmed-
+        shape traffic all take that lane, byte-identical to the
+        pre-fusing engine). Fully async, like every dispatch here."""
+        if not self._fused_width:
+            return None
+        lp = None
+        for cand in self._long_prefills:
+            if (self.slots[cand.slot_idx] is cand.slot
+                    and not cand.req.cancelled
+                    and cand.pos < len(cand.ids)
+                    and cand.cache.k.shape[-2] >= self._fused_width):
+                lp = cand
+                break
+        if lp is None:
+            return None
+        s_total = lp.cache.k.shape[-2]
+        if self._warm_ks and (s_total, K) not in self._warm_fused:
+            # A cold fused variant would freeze every live stream for a
+            # 20-40 s compile; the interleaved lane takes over. Keyed on
+            # _warm_ks (did ANY warmup run), so a warmup without
+            # long_prompts=True — which leaves _warm_fused empty — also
+            # refuses, instead of reading "empty = anything goes".
+            return None
+        part = lp.ids[lp.pos:lp.pos + self._fused_width]
+        tok = self._chunk_buf(self._fused_width)
+        tok[0, :len(part)] = part
+        (block, self._last_tokens, self.pool, chunk_logits,
+         lp.cache) = engine_model.fused_decode_prefill_step(
+            self.params, self.cfg, self.pool, self._last_tokens,
+            self._put(tables), self._put(lengths),
+            self._put(active_mask), self._put(temps),
+            self._put(top_ps), self._put(top_ks),
+            self._next_key(), lp.cache, self._put(tok),
+            self._put(np.int32(len(part))), K, self.use_pallas,
+            sampling_flags=flags, mesh=self.mesh)
+        lp.pos += len(part)
+        lp.beat = self._beat  # the rider consumed this beat's chunk slot
+        self.metrics.fused_steps += 1
+        self.metrics.fused_prefill_tokens += len(part)
+        # Real (unpadded) prompt tokens only — the rider's fixed-width
+        # padding must not inflate the prefill meter.
+        self.metrics.prefill_tokens += len(part)
+        if lp.pos >= len(lp.ids):
+            self._long_prefills.remove(lp)
+            self._finish_long_prefill(lp, chunk_logits)
+        return block
 
     def _dispatch_decode_spec(self) -> bool:
         """Speculative twin of _dispatch_decode: K outer VERIFY steps,

@@ -171,6 +171,18 @@ class OpenAIServer:
                 "evictions": m.prefix_evictions,
                 "hit_tokens": m.prefix_hit_tokens,
             }
+        ecfg = getattr(self.llm, "ecfg", None)
+        if ecfg is not None:
+            # Always present (counters 0, enabled false when the knob
+            # is off) so dashboards can alert on prefill_stall_beats
+            # without the key flickering in and out of the payload.
+            m = self.llm.metrics
+            payload["fused_prefill"] = {
+                "enabled": bool(getattr(ecfg, "fused_prefill", False)),
+                "fused_steps": m.fused_steps,
+                "fused_prefill_tokens": m.fused_prefill_tokens,
+                "prefill_stall_beats": m.prefill_stall_beats,
+            }
         return web.json_response(payload)
 
     async def handle_models(self, request: web.Request) -> web.Response:

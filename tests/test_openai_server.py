@@ -108,6 +108,24 @@ def test_health_and_metrics_surface_prefix_cache_counters():
     assert m["prefix_hits"] == 3 and m["prefix_hit_tokens"] == 48
 
 
+def test_health_and_metrics_surface_fused_counters(server):
+    """The fused-prefill counters are always present: /health carries
+    the section (enabled=false, zeros) and /metrics reports the keys as
+    0 — never absent — when engine.fused_prefill is off."""
+    async def body(c):
+        h = await (await c.get("/health")).json()
+        m = await (await c.get("/metrics")).json()
+        return h, m
+
+    h, m = _client_call(server, body)
+    assert h["fused_prefill"] == {
+        "enabled": False, "fused_steps": 0, "fused_prefill_tokens": 0,
+        "prefill_stall_beats": 0}
+    assert m["fused_steps"] == 0
+    assert m["fused_prefill_tokens"] == 0
+    assert m["prefill_stall_beats"] == 0
+
+
 def test_chat_completion_non_streaming(server):
     async def body(c):
         r = await c.post("/v1/chat/completions", json={
