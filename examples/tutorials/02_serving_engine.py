@@ -75,7 +75,10 @@ engine.stop()
 # n-gram drafter proposes k tokens from the sequence's own history and
 # ONE verify forward checks them — up to k+1 committed tokens per
 # weight read. Output is exactly the greedy continuation (acceptance
-# only changes speed); sampled requests are rejected at submit.
+# only changes speed); sampled requests serve through a per-request
+# non-speculative fallback plan (they just don't speculate), and
+# `speculative_tree_branches` widens the draft into a multi-branch
+# tree verified in one step (see docs/architecture.md).
 
 # %%
 import dataclasses

@@ -214,10 +214,35 @@ class EngineConfig:
     # Greedy self-speculative decoding: draft k tokens per step from an
     # on-device n-gram history lookup and verify them in ONE forward —
     # up to k+1 tokens per weight read (the NIM/TRT-LLM speculative-
-    # decoding role). 0 = off. Greedy-only: a speculative engine
-    # rejects sampled requests at submit; emitted tokens are always
-    # exactly the greedy continuation regardless of acceptance.
+    # decoding role). 0 = off. Verification is greedy-only; sampled
+    # requests (temperature > 0) fall back per-request to the
+    # non-speculative plan on the same engine (they serve, they just
+    # don't speculate). Greedy streams are always exactly the greedy
+    # continuation regardless of acceptance.
     speculative_k: int = 0
+    # Multi-branch tree drafts (the EAGLE/Medusa tree-verify role,
+    # drafted from the n-gram history lattice): each verify step
+    # proposes `speculative_tree_branches` independent k-deep
+    # continuations — one per recent occurrence of the current token,
+    # with the last branch following the longest-suffix (bigram) match
+    # instead — and verifies the whole packed tree in ONE widened
+    # decode step via a tree-attention mask. Commit semantics are identical to the
+    # linear chain (accepted-prefix + bonus, byte-identical greedy
+    # streams); more branches only raise the acceptance ceiling.
+    # 0 or 1 = the linear single-chain draft (byte-identical to the
+    # pre-tree engine). Requires speculative_k > 0.
+    speculative_tree_branches: int = 0
+    # Composable step plans: describe every device dispatch as a
+    # declarative StepPlan {decode block, optional spec-verify width,
+    # optional prefill-rider width} lowered by engine_model.plan_step,
+    # so speculation and the fused prefill rider COMPOSE instead of
+    # excluding each other (one warmed jitted step can carry decode +
+    # tree verify + a prefill chunk). warmup() precompiles the
+    # reachable plan lattice; dispatch falls back to a narrower plan
+    # (drop the rider) rather than compiling a cold shape mid-traffic.
+    # Off by default — off is byte-identical to the lane-exclusive
+    # scheduler (speculative engines then never fuse).
+    step_plans: bool = False
     # Emission pacing: a landed K-step decode block delivers up to K
     # tokens per stream at once; with few live streams the pacer
     # re-spaces those bursts over the observed block interval (capped

@@ -36,9 +36,12 @@ HOT_PATH_MARK = re.compile(r"#\s*graftlint:\s*hot-path")
 # Known hot functions per module basename: the engine scheduler beat
 # and the micro-batcher dispatcher. Extend via the marker comment.
 HOT_DEFAULTS = {
+    # The StepPlan dispatch path (engine.py PR-6 refactor): plan
+    # selection + the single plan_step lowering replaced the old
+    # per-lane _dispatch_decode_spec/_dispatch_fused_rider functions.
     "engine.py": {"_loop", "_admit_waiting", "_dispatch_decode",
-                  "_dispatch_decode_spec", "_advance_long_prefills",
-                  "_emit_ready_first_tokens"},
+                  "_select_plan", "_dispatch_plan", "_rider_candidate",
+                  "_advance_long_prefills", "_emit_ready_first_tokens"},
     "batcher.py": {"_loop", "_run", "_take_group"},
 }
 DEVICE_NAME_RE = re.compile(r"(^|_)dev(_|$)|device", re.IGNORECASE)

@@ -150,9 +150,11 @@ class _Slot:
 class _InFlight:
     """One dispatched-but-unprocessed decode block."""
 
-    __slots__ = ("block", "metas", "K", "releases", "spec_worst")
+    __slots__ = ("block", "metas", "K", "releases", "spec_worst",
+                 "plain_spec")
 
-    def __init__(self, block, metas, K, spec_worst: int = 0):
+    def __init__(self, block, metas, K, spec_worst: int = 0,
+                 plain_spec: bool = False):
         # Plain blocks: device [B, K+1]. Speculative blocks: a
         # (targets [B, K, r], counts [B, K]) tuple.
         self.block = block
@@ -161,6 +163,10 @@ class _InFlight:
         # >0 marks a speculative block: worst-case tokens per slot
         # (K * (k+1)); landing refunds the unaccepted remainder.
         self.spec_worst = spec_worst
+        # A plain (non-speculative) block dispatched on a SPECULATIVE
+        # engine (the sampled-request fallback plan): landing advances
+        # each surviving slot's kv_len by exactly K.
+        self.plain_spec = plain_spec
         self.releases: List = []  # SequencePages freed once this block lands
 
 
@@ -213,6 +219,14 @@ class EngineMetrics:
         # acceptance-rate gauge (1.0 = no drafts accepted, k+1 = all).
         self.spec_committed = 0
         self.spec_slot_steps = 0
+        # Step-plan counters: distinct plan-lattice points warmup()
+        # precompiled (0 until warmup runs), and dispatches a
+        # speculative engine demoted to the plain plan because a live
+        # sampled request cannot ride greedy verification. Always
+        # present in snapshot() — 0, never absent — like the fused
+        # counters below.
+        self.plan_variants_compiled = 0
+        self.spec_fallback_steps = 0
         # Prompt tokens actually run through a prefill forward (valid
         # tokens, not bucket padding) — with the prefix cache on, a hit
         # adds only its uncached suffix here.
@@ -300,10 +314,15 @@ class EngineMetrics:
             "prefix_miss": self.prefix_miss,
             "prefix_evictions": self.prefix_evictions,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            # Always present — 0, never absent (the PR-5 counter
+            # convention): dashboards must not see the speculation
+            # gauge appear and disappear with traffic.
+            "spec_tokens_per_step": (self.spec_committed
+                                     / self.spec_slot_steps
+                                     if self.spec_slot_steps else 0.0),
+            "plan_variants_compiled": self.plan_variants_compiled,
+            "spec_fallback_steps": self.spec_fallback_steps,
         }
-        if self.spec_slot_steps:
-            out["spec_tokens_per_step"] = (self.spec_committed
-                                           / self.spec_slot_steps)
         return out
 
 
@@ -430,6 +449,17 @@ class LLMEngine:
         # before a block lands, so host page bookkeeping tracks upper
         # bounds and reconciles at landing).
         self._spec_k = max(0, self.ecfg.speculative_k)
+        # Tree-verify drafts (engine.speculative_tree_branches): <= 1
+        # keeps the linear chain (byte-identical). The commit contract
+        # is unchanged either way (at most k+1 tokens per verify
+        # step), but a tree step WRITES k/v for every packed node, so
+        # page allocation floors at _spec_tree_nodes per step while
+        # the token/commit bookkeeping stays at _spec_r.
+        self._tree_branches = (max(0, self.ecfg.speculative_tree_branches)
+                               if self._spec_k else 0)
+        self._spec_r = self._spec_k + 1
+        self._spec_tree_nodes = 1 + max(1, self._tree_branches) * self._spec_k \
+            if self._spec_k else 1
         if self._spec_k:
             self._history = jnp.zeros(
                 (self.ecfg.max_batch_size, self.ecfg.max_seq_len), jnp.int32)
@@ -469,10 +499,13 @@ class LLMEngine:
         # Fused prefill+decode dispatch (engine.fused_prefill): the
         # rider's chunk width — largest power of two within both the
         # biggest bucket and the per-step token budget. 0 = fusing
-        # unavailable (knob off, speculative engine, or a non-positive
-        # budget); the interleaved lane then carries all chunks.
+        # unavailable (knob off, a non-positive budget, or a
+        # speculative engine WITHOUT engine.step_plans — composable
+        # plans are what give the spec engine a fused lattice point);
+        # the interleaved lane then carries all chunks.
         self._fused_width = 0
-        if (self.ecfg.fused_prefill and self._spec_k == 0
+        if (self.ecfg.fused_prefill
+                and (self._spec_k == 0 or self.ecfg.step_plans)
                 and self.ecfg.fused_token_budget > 0):
             w = 1
             while w * 2 <= min(self.buckets[-1],
@@ -481,8 +514,13 @@ class LLMEngine:
             self._fused_width = w
         # (S_total, K) fused variants precompiled by warmup(); empty
         # means any shape may dispatch and compile on demand (CPU
-        # tests). Same contract as _warm_ks.
+        # tests). Same contract as _warm_ks. _warm_spec_fused is the
+        # speculative twin (fused_spec_prefill_step variants);
+        # _warm_plans records every warmed StepPlan lattice point
+        # (plan_variants_compiled in /metrics).
         self._warm_fused: set = set()
+        self._warm_spec_fused: set = set()
+        self._warm_plans: set = set()
         # (S_total, width) chunked-prefill variants warmed for the
         # interleaved lane — the tail chunk buckets to the smallest
         # warmed power-of-two width instead of padding to full chunk.
@@ -528,7 +566,7 @@ class LLMEngine:
     # -- lifecycle ---------------------------------------------------------
 
     def warmup(self, buckets=None, group_sizes=None, ks=None,
-               sampled: bool = False,
+               sampled: Optional[bool] = None,
                long_prompts: bool = False,
                long_prompt_lengths=None) -> "LLMEngine":
         """Precompile the prefill/decode graph variants BEFORE serving.
@@ -542,6 +580,16 @@ class LLMEngine:
         All dummy page-table rows point at the page-0 garbage sink, so
         warmup never touches real KV state."""
         assert not self._running, "warmup() must run before start()"
+        if sampled is None:
+            # Speculative engines warm the sampled-request fallback by
+            # DEFAULT: since the submit-time 422 was lifted, any
+            # temperature > 0 request can demote a dispatch to the
+            # plain spec-state plan, and that variant compiling cold on
+            # the scheduler thread freezes every live stream. Plain
+            # engines keep the old opt-in (their sampled variants were
+            # always reachable; callers that serve sampled traffic
+            # pass sampled=True, as serving/__main__.py does).
+            sampled = self._spec_k > 0
         ps = self.pool.page_size
         if group_sizes is None:
             group_sizes = []
@@ -597,8 +645,8 @@ class LLMEngine:
                         toks)
         B = self.ecfg.max_batch_size
         if self._spec_k:
-            # Spec engines dispatch ONLY verify blocks; warm those
-            # (per outer-steps bucket) instead of the plain K variants.
+            # Spec engines dispatch verify blocks (linear or tree) per
+            # outer-steps bucket instead of the plain K variants.
             for steps in ks:
                 (_, _, self._last_tokens, self._dev_lengths,
                  self._history, self.pool) = engine_model.decode_spec_multi_step(
@@ -607,7 +655,35 @@ class LLMEngine:
                     self._put(np.zeros((B, self.max_pages), np.int32)),
                     self._put(np.zeros((B,), bool)),
                     n_steps=steps, k=self._spec_k,
+                    n_branches=self._tree_branches,
                     use_pallas=self.use_pallas, mesh=self.mesh)
+                self._warm_plans.add(engine_model.StepPlan(
+                    decode_k=steps, spec_k=self._spec_k,
+                    tree_branches=self._tree_branches))
+            if sampled:
+                # The sampled-request fallback plan: plain decode over
+                # the spec engine's device state. Fallback dispatches
+                # always launch the general-sampling variant (even when
+                # the demoting slot dropped out of the batch), so it is
+                # the only one to warm.
+                for steps in ks:
+                    (_, self._last_tokens, self._dev_lengths,
+                     self._history, self.pool) = \
+                        engine_model.decode_plain_spec_state_multi_step(
+                            self.params, self.cfg, self.pool,
+                            self._history, self._last_tokens,
+                            self._dev_lengths,
+                            self._put(np.zeros((B, self.max_pages),
+                                               np.int32)),
+                            self._put(np.zeros((B,), bool)),
+                            self._put(np.zeros((B,), np.float32)),
+                            self._put(np.ones((B,), np.float32)),
+                            self._put(np.zeros((B,), np.int32)),
+                            key, steps, self.use_pallas,
+                            sampling_flags=(False, True, True),
+                            mesh=self.mesh)
+                    self._warm_plans.add(engine_model.StepPlan(
+                        decode_k=steps, spec_state=True))
             # Admission history-write variants: every (group-size,
             # bucket) shape _prefill_group can produce, plus the
             # full-width chunked-prefill row — cold scatter compiles on
@@ -628,6 +704,7 @@ class LLMEngine:
         for k in ks:
             if self._spec_k:
                 break
+            self._warm_plans.add(engine_model.StepPlan(decode_k=k))
             for flags in flag_sets:
                 _, self._last_tokens, self.pool =                     engine_model.decode_multi_step(
                         self.params, self.cfg, self.pool,
@@ -718,12 +795,39 @@ class LLMEngine:
                     # can reach in live traffic: K is capped by
                     # prefill_decode_k_cap whenever a long prefill is
                     # in progress, so only those (and the always-
-                    # dispatchable K=1) need compiling.
+                    # dispatchable K=1) need compiling. Speculative
+                    # engines (reachable only with engine.step_plans)
+                    # warm the composed spec+rider program instead.
                     B = self.ecfg.max_batch_size
                     cap = self.ecfg.prefill_decode_k_cap
                     fks = sorted({k for k in ks if cap <= 0 or k <= cap}
                                  | {1})
                     for kf in fks:
+                        if self._spec_k:
+                            (_, _, self._last_tokens, self._dev_lengths,
+                             self._history, self.pool, logits, cache) = \
+                                engine_model.fused_spec_prefill_step(
+                                    self.params, self.cfg, self.pool,
+                                    self._history, self._last_tokens,
+                                    self._dev_lengths,
+                                    self._put(np.zeros(
+                                        (B, self.max_pages), np.int32)),
+                                    self._put(np.zeros((B,), bool)),
+                                    cache,
+                                    self._put(np.zeros(
+                                        (1, self._fused_width), np.int32)),
+                                    self._put(np.int32(1)),
+                                    n_steps=kf, k=self._spec_k,
+                                    n_branches=self._tree_branches,
+                                    use_pallas=self.use_pallas,
+                                    mesh=self.mesh)
+                            self._warm_spec_fused.add((s_tot, kf))
+                            self._warm_plans.add(engine_model.StepPlan(
+                                decode_k=kf, spec_k=self._spec_k,
+                                tree_branches=self._tree_branches,
+                                rider_width=self._fused_width,
+                                rider_s_total=s_tot))
+                            continue
                         for flags in flag_sets:
                             (_, self._last_tokens, self.pool, logits,
                              cache) = engine_model.fused_decode_prefill_step(
@@ -743,6 +847,10 @@ class LLMEngine:
                                 self.use_pallas, sampling_flags=flags,
                                 mesh=self.mesh)
                             self._warm_fused.add((s_tot, kf))
+                            self._warm_plans.add(engine_model.StepPlan(
+                                decode_k=kf,
+                                rider_width=self._fused_width,
+                                rider_s_total=s_tot))
             if logits is not None:
                 # The chunked-prefill FINISH path samples through its
                 # own jit variants (sample_token / set_last_token),
@@ -804,6 +912,14 @@ class LLMEngine:
                                            np.int32)),
                         self._put(np.ones((1,), np.int32)),
                         self._put(np.zeros((1,), np.int32)))
+        # Rider-only plans (the idle interleaved lane's chunk
+        # dispatches) are warmed via the chunk-width loops above; the
+        # lattice size is the observability gauge for "how many jitted
+        # step programs can this engine dispatch without compiling".
+        for s_tot, w in self._warm_chunk_widths:
+            self._warm_plans.add(engine_model.StepPlan(
+                rider_width=w, rider_s_total=s_tot))
+        self.metrics.plan_variants_compiled = len(self._warm_plans)
         jax.block_until_ready(self._last_tokens)
         _LOG.info("engine warmup: %d prefill + %d decode variants compiled",
                   len(self.buckets if buckets is None else buckets)
@@ -848,13 +964,14 @@ class LLMEngine:
     # -- public API --------------------------------------------------------
 
     def submit(self, req: GenRequest) -> GenRequest:
-        if self._spec_k and req.temperature > 0.0:
-            raise ValueError(
-                "this engine runs greedy self-speculative decoding "
-                "(engine.speculative_k > 0), which verifies drafts "
-                "against argmax targets; sampled requests need a "
-                "non-speculative engine (set temperature=0 or "
-                "speculative_k=0)")
+        # Sampled requests (temperature > 0) on a speculative engine
+        # are NOT rejected: greedy verification cannot honor them, so
+        # dispatches with a live sampled slot run the non-speculative
+        # plan over the engine's device-authoritative state instead
+        # (decode_plain_spec_state_multi_step; counted by
+        # metrics.spec_fallback_steps). The request serves — it just
+        # doesn't speculate — and greedy traffic resumes verify plans
+        # the moment no sampled slot is dispatchable.
         # Prompts beyond the largest bucket go through CHUNKED prefill
         # (bucket-size pieces into a contiguous scratch cache, then one
         # scatter into the page pool), so the real ceiling is the page
@@ -1450,10 +1567,17 @@ class LLMEngine:
                                                    s_total)
                     tok = self._chunk_buf(width)
                     tok[0, :len(part)] = part
-                    logits, lp.cache = engine_model.prefill_chunk_step(
-                        self.params, self.cfg, lp.cache, self._put(tok),
-                        self._put(np.int32(len(part))), self.use_pallas,
-                        mesh=self.mesh)
+                    # A rider-only plan (decode_k=0): the idle/fallback
+                    # lane's chunk dispatch goes through the same
+                    # plan_step entry point as every other device step.
+                    res = engine_model.plan_step(
+                        self.params, self.cfg,
+                        engine_model.StepPlan(rider_width=width,
+                                              rider_s_total=s_total),
+                        cache=lp.cache, chunk_tokens=self._put(tok),
+                        chunk_valid=self._put(np.int32(len(part))),
+                        use_pallas=self.use_pallas, mesh=self.mesh)
+                    logits, lp.cache = res["chunk_logits"], res["cache"]
                     lp.pos += len(part)
                     self.metrics.prefill_tokens += len(part)
                     if lp.pos >= len(lp.ids):
@@ -1512,11 +1636,16 @@ class LLMEngine:
         s_total = lp.cache.k.shape[-2]
         if s_total < self._fused_width:
             return False
+        warm = self._warm_spec_fused if self._spec_k else self._warm_fused
         if self._warm_ks and not any(
-                (s_total, k) in self._warm_fused for k in self._warm_ks):
+                (s_total, k) in warm for k in self._warm_ks):
             # A warmup ran but didn't cover this fused shape (e.g.
             # long_prompts=False): never compile it mid-traffic — the
             # interleaved lane carries the chunks instead.
+            return False
+        if self._spec_k and self._sampled_live():
+            # The sampled-request fallback plan has no rider variant;
+            # the interleaved lane carries chunks while it runs.
             return False
         for s in self.slots:
             if (s is not None and not s.prefilling
@@ -1601,20 +1730,65 @@ class LLMEngine:
                        jax.device_put(cache.v, kv_sh),
                        jax.device_put(cache.lengths, self._replicated))
 
+    def _slot_used(self, slot: "_Slot") -> int:
+        """Tokens this slot's pages must already cover: the host-exact
+        sequence length on a plain engine; the reconciled-plus-in-
+        flight worst case on a speculative one (lengths are device-
+        authoritative there — the host cannot know acceptance before a
+        block lands)."""
+        return (slot.kv_len + slot.kv_worst) if self._spec_k \
+            else slot.seq.length
+
+    def _sampled_live(self) -> bool:
+        """True when a live, dispatchable slot wants sampling
+        (temperature > 0). On a speculative engine this demotes the
+        next dispatch to the plain spec-state plan — greedy
+        verification cannot honor sampling, so the request serves
+        without speculating (the documented per-request fallback;
+        verify plans resume the moment no sampled slot is
+        dispatchable). A sampled slot with no page capacity for even
+        one token does NOT demote: the live filter will starve it out
+        of this batch anyway (for the plain plan too), so demoting
+        would cost every greedy stream its speculation while the
+        stuck slot waits on the reaper."""
+        for s in self.slots:
+            if (s is not None and not s.prefilling
+                    and not s.req.cancelled
+                    and s.req.temperature > 0.0
+                    and s.req.max_new_tokens - s.scheduled > 0
+                    and self._advance_capacity(s, self._slot_used(s))[0]
+                    >= 1):
+                return True
+        return False
+
+    # graftlint: hot-path
     def _dispatch_decode(self) -> bool:
-        """Dispatch (async) K fused decode steps over the slot batch.
-        Sampling happens on device and tokens chain device-side, so this
-        returns without any host<->device sync; results are consumed
-        later by _process_block."""
-        if self._spec_k:
-            return self._dispatch_decode_spec()
+        """Dispatch (async) ONE composed step over the slot batch:
+        build the batch state, select the widest warmed StepPlan
+        (decode block + optional spec-verify width + optional prefill
+        rider — _select_plan) and lower it through ONE
+        engine_model.plan_step dispatch (_dispatch_plan). Sampling /
+        verification happens on device and tokens chain device-side,
+        so this returns without any host<->device sync; results are
+        consumed later by _process_block.
+
+        This is the single dispatch path the old partially-exclusive
+        lanes (_dispatch_decode / _dispatch_decode_spec /
+        _dispatch_fused_rider) collapsed into: with engine.step_plans
+        off the selected plans reproduce the lane-exclusive decisions
+        exactly (speculative engines never fuse), with it on the
+        lattice composes."""
         B = len(self.slots)
+        spec_mode = self._spec_k > 0
+        if spec_mode and self._sampled_live():
+            spec_mode = False  # per-request fallback: plain plan
+        # Per-step commit worst case r (tokens the budget/bookkeeping
+        # reserve) vs page-write worst case r_nodes (a tree verify
+        # step scatters k/v for EVERY packed node, accepted or not).
+        # Linear/plain engines: r_nodes == r, byte-identical sizing.
+        r = self._spec_r if spec_mode else 1
+        r_nodes = self._spec_tree_nodes if spec_mode else 1
         K = max(1, self.ecfg.decode_steps_per_dispatch)
-        # (r3 had a K=1 "TTFT ramp" for slots awaiting their first
-        # token. Gone in r4: first tokens are emitted off the async
-        # prefill copy, never off a decode-block fetch, so shrinking
-        # the block bought nothing and fragmented the burst into
-        # one-token-per-weight-read blocks during arrival churn.)
         lengths = np.ones((B,), np.int32)
         tables = np.zeros((B, self.max_pages), np.int32)
         temps = np.zeros((B,), np.float32)
@@ -1630,16 +1804,14 @@ class LLMEngine:
             if s.req.cancelled:
                 self._finish(i, "cancelled")
                 continue
-            cap, _ = self._advance_capacity(s, s.seq.length)
-            if cap < 1:
+            cap, _ = self._advance_capacity(s, self._slot_used(s))
+            if cap < r_nodes:
                 self._starve(i)
                 continue
             if s.req.max_new_tokens - s.scheduled <= 0:
                 # Every token this request asked for is already emitted
                 # or in flight — another block would be pure overshoot
-                # (device work + a ~100 ms readback nobody consumes; it
-                # also made bench's back-to-back single-request TTFT
-                # read ~150 ms above the breakdown instrument, r3).
+                # (device work + a ~100 ms readback nobody consumes).
                 continue
             live.append(i)
         if not live:
@@ -1648,28 +1820,24 @@ class LLMEngine:
             # Low-occupancy (arrival-heavy) regime: short blocks keep
             # the device queue shallow, so a new arrival's prefill is
             # never stuck behind ~K full weight reads of mostly-empty
-            # decode work (staggered-load TTFT target <=200 ms). At
-            # high occupancy the K=8 blocks that maximize throughput
-            # return; per-token device cost is identical either way —
-            # K only amortizes fetches, which overlap compute anyway.
+            # decode work. At high occupancy the K=8 blocks that
+            # maximize throughput return.
             K = min(K, 2)
         if self._long_prefills and self.ecfg.prefill_decode_k_cap > 0:
             # Chunked-prefill priority lane: short decode blocks keep
             # the device queue shallow so prefill chunks interleave at
-            # a fine grain (8k-under-load TTFT ~3.4 s -> ~2 s); the
-            # emission pacer absorbs the cadence cost for live streams.
+            # a fine grain.
             K = min(K, self.ecfg.prefill_decode_k_cap)
-        # Shared fused-step count. Two caps with different semantics:
-        # page capacity is HARD (steps past it write out of bounds) —
-        # round DOWN; the token budget is SOFT (steps past the last
-        # requested token are dropped at emission) — round UP to the
-        # nearest precompiled K rather than shrink onto a cold variant
-        # that would freeze every stream behind a 20-40 s compile.
-        cap_steps = min(self._advance_capacity(
-            self.slots[i], self.slots[i].seq.length)[0] for i in live)
+        # Two caps with different semantics: page capacity is HARD
+        # (steps past it write out of bounds) — round DOWN; the token
+        # budget is SOFT (steps past the last requested token are
+        # dropped at emission) — round UP to the nearest precompiled K
+        # rather than shrink onto a cold variant.
+        cap_min = min(self._advance_capacity(
+            self.slots[i], self._slot_used(self.slots[i]))[0] for i in live)
         max_rem = max(self.slots[i].req.max_new_tokens
                       - self.slots[i].scheduled for i in live)
-        K = self._pick_k(min(K, max(1, cap_steps)))
+        K = self._pick_k(min(K, max(1, (cap_min - (r_nodes - r)) // r)))
         if max_rem < K:
             if self._warm_ks:
                 fits = sorted(k for k in self._warm_ks
@@ -1679,249 +1847,209 @@ class LLMEngine:
                 K = self._pick_k(max(1, max_rem))
         while K & (K - 1):
             K &= K - 1
+        worst = K * r                    # commit / token-budget bound
+        alloc = (K - 1) * r + r_nodes    # page-write bound
+        # ensure() pre-advances seq.length, so capture base usage once —
+        # a shrink-retry pass must re-ensure from the same starting
+        # point.
+        base_lens = {i: self._slot_used(self.slots[i]) for i in live}
+        metas: List = []
         active: List[int] = []
-        # ensure() pre-advances seq.length, so capture base lengths once —
-        # a shrink-retry pass must re-ensure from the same starting point.
-        base_lens = {i: self.slots[i].seq.length for i in live}
         while True:
             shrink_to = None
             active = []
+            metas = []
             active_mask[:] = False
             for i in live:
                 s = self.slots[i]
                 if s is None:
                     continue
-                base_len = base_lens[i]
+                base = base_lens[i]
                 try:
-                    s.seq.ensure(base_len + K)
+                    s.seq.ensure(base + alloc)
                 except MemoryError:
                     # Pool can't cover K steps. Shrink K to what the
                     # slot's allocated pages PLUS the remaining free
-                    # pages can hold (avail >= 1 guarantees ensure at
-                    # the shrunken K succeeds); starve only when even
-                    # one token cannot be stored anywhere.
-                    _, avail = self._advance_capacity(s, base_len)
-                    if avail >= 1 and K > 1:
-                        shrink_to = max(1, avail)
+                    # pages can hold; starve only when even one step
+                    # cannot be stored anywhere.
+                    _, avail = self._advance_capacity(s, base)
+                    if avail >= r_nodes and K > 1:
+                        shrink_to = max(1, (avail - (r_nodes - r)) // r)
                         break
-                    if avail < 1:
+                    if avail < r_nodes:
                         self._starve(i)
                     continue
                 active.append(i)
                 active_mask[i] = True
                 s.no_capacity = False  # capacity proven; undo stale starve
-                lengths[i] = base_len + 1  # incl. the incoming token
                 tables[i] = s.seq.table_row()
-                temps[i] = s.req.temperature
-                top_ps[i] = s.req.top_p
-                top_ks[i] = s.req.top_k
+                if spec_mode:
+                    metas.append((i, s, base))
+                else:
+                    lengths[i] = base + 1  # incl. the incoming token
+                    temps[i] = s.req.temperature
+                    top_ps[i] = s.req.top_p
+                    top_ks[i] = s.req.top_k
             if shrink_to is None:
                 break
             K = self._pick_k(shrink_to)
+            worst = K * r
+            alloc = (K - 1) * r + r_nodes
         if not active:
             return False
         # Static sampling flags from host-known params: a fully greedy
         # batch (the default) skips all [B, vocab] sort work on device.
-        # Exactly TWO variants per K bucket (all-greedy vs general) so a
-        # sampled request joining a warm greedy batch costs at most one
-        # extra compile, ever — not one per flag combination.
-        all_greedy = bool(all(temps[i] <= 0.0 for i in active))
+        # Exactly TWO variants per K bucket (all-greedy vs general).
+        # A spec_state fallback dispatch always takes the GENERAL
+        # variant — the only one warmup compiles for it, and the
+        # sampled slot that demoted spec_mode can drop out of `active`
+        # after _sampled_live() (starved on pages, ensure failure),
+        # which would otherwise launch an all-greedy variant cold.
+        # Greedy rows still take exact argmax inside sample().
+        spec_state_fb = self._spec_k > 0 and not spec_mode
+        all_greedy = spec_mode or (
+            not spec_state_fb
+            and bool(all(temps[i] <= 0.0 for i in active)))
         flags = (True, False, False) if all_greedy else (False, True, True)
-        block = self._dispatch_fused_rider(tables, lengths, active_mask,
-                                           temps, top_ps, top_ks, K, flags)
-        if block is None:
-            block, self._last_tokens, self.pool = \
-                engine_model.decode_multi_step(
-                    self.params, self.cfg, self.pool, self._last_tokens,
-                    self._put(tables), self._put(lengths),
-                    self._put(active_mask), self._put(temps),
-                    self._put(top_ps), self._put(top_ks),
-                    self._next_key(), K, self.use_pallas,
-                    sampling_flags=flags, mesh=self.mesh)
-        metas = []
-        for i in active:
-            s = self.slots[i]
-            metas.append((i, s, 0 if s.awaiting_first else 1))
-            s.awaiting_first = False
-            s.scheduled += K
+        plan, lp = self._select_plan(K, spec_mode)
+        res = self._dispatch_plan(plan, lp, tables, lengths, active_mask,
+                                  temps, top_ps, top_ks, flags)
         self.metrics.decode_steps += K
         self.metrics.busy_slots_acc += len(active) * K
-        if self._async_block_copy:
-            # Start the [B, K+1] readback as soon as the block is
-            # dispatched: transfers overlap newer blocks' compute, so
-            # the later blocking fetch finds the bytes already landed
-            # (or in flight) instead of paying the full tunnel RTT.
-            try:
-                block.copy_to_host_async()
-            except AttributeError:
-                pass
-        self._inflight.append(_InFlight(block, metas, K))
+        if spec_mode:
+            for i in active:
+                s = self.slots[i]
+                s.awaiting_first = False
+                s.scheduled += worst
+                s.kv_worst += worst
+            block = (res["targets"], res["counts"])
+            if self._async_block_copy:
+                for b in block:
+                    try:
+                        b.copy_to_host_async()
+                    except AttributeError:
+                        pass
+            self._inflight.append(_InFlight(block, metas, K,
+                                            spec_worst=worst))
+        else:
+            block = res["block"]
+            for i in active:
+                s = self.slots[i]
+                metas.append((i, s, 0 if s.awaiting_first else 1))
+                s.awaiting_first = False
+                s.scheduled += K
+                if plan.spec_state:
+                    # On a speculative engine _slot_used reads
+                    # kv_len + kv_worst, and kv_len only moves at
+                    # landing — reserve this block's K writes now so a
+                    # sibling dispatch (pipeline_depth > 1) ensures
+                    # pages past the in-flight block instead of
+                    # scattering K tokens beyond what ensure() covered.
+                    s.kv_worst += K
+            if plan.spec_state:
+                self.metrics.spec_fallback_steps += 1
+            if self._async_block_copy:
+                try:
+                    block.copy_to_host_async()
+                except AttributeError:
+                    pass
+            self._inflight.append(_InFlight(block, metas, K,
+                                            plain_spec=plan.spec_state))
         return True
 
     # graftlint: hot-path
-    def _dispatch_fused_rider(self, tables, lengths, active_mask, temps,
-                              top_ps, top_ks, K: int, flags):
-        """Fused prefill+decode dispatch (engine.fused_prefill): fold
-        the next chunk of an in-progress long prefill into this decode
-        dispatch as ONE jitted step, so the prefill advances without a
-        standalone batch-of-1 program serializing ahead of decode
-        blocks on the device queue. Returns the decode block, or None
-        when no rider applies (plain decode_multi_step dispatches
-        instead — fused-off, speculative, idle-prefill and unwarmed-
-        shape traffic all take that lane, byte-identical to the
-        pre-fusing engine). Fully async, like every dispatch here."""
+    def _rider_candidate(self) -> Optional["_LongPrefill"]:
+        """The in-progress long prefill whose next chunk can ride the
+        next dispatch (fusing available, prompt tokens remaining,
+        scratch wide enough), or None."""
         if not self._fused_width:
             return None
-        lp = None
         for cand in self._long_prefills:
             if (self.slots[cand.slot_idx] is cand.slot
                     and not cand.req.cancelled
                     and cand.pos < len(cand.ids)
                     and cand.cache.k.shape[-2] >= self._fused_width):
-                lp = cand
-                break
-        if lp is None:
-            return None
-        s_total = lp.cache.k.shape[-2]
-        if self._warm_ks and (s_total, K) not in self._warm_fused:
-            # A cold fused variant would freeze every live stream for a
-            # 20-40 s compile; the interleaved lane takes over. Keyed on
-            # _warm_ks (did ANY warmup run), so a warmup without
-            # long_prompts=True — which leaves _warm_fused empty — also
-            # refuses, instead of reading "empty = anything goes".
-            return None
-        part = lp.ids[lp.pos:lp.pos + self._fused_width]
-        tok = self._chunk_buf(self._fused_width)
-        tok[0, :len(part)] = part
-        (block, self._last_tokens, self.pool, chunk_logits,
-         lp.cache) = engine_model.fused_decode_prefill_step(
-            self.params, self.cfg, self.pool, self._last_tokens,
-            self._put(tables), self._put(lengths),
-            self._put(active_mask), self._put(temps),
-            self._put(top_ps), self._put(top_ks),
-            self._next_key(), lp.cache, self._put(tok),
-            self._put(np.int32(len(part))), K, self.use_pallas,
-            sampling_flags=flags, mesh=self.mesh)
-        lp.pos += len(part)
-        lp.beat = self._beat  # the rider consumed this beat's chunk slot
-        self.metrics.fused_steps += 1
-        self.metrics.fused_prefill_tokens += len(part)
-        # Real (unpadded) prompt tokens only — the rider's fixed-width
-        # padding must not inflate the prefill meter.
-        self.metrics.prefill_tokens += len(part)
-        if lp.pos >= len(lp.ids):
-            self._long_prefills.remove(lp)
-            self._finish_long_prefill(lp, chunk_logits)
-        return block
+                return cand
+        return None
 
-    def _dispatch_decode_spec(self) -> bool:
-        """Speculative twin of _dispatch_decode: K outer VERIFY steps,
-        each committing 1..k+1 tokens. Lengths are device-authoritative
-        (acceptance is unknown until the block lands); the host ensures
-        pages for the worst case and reconciles at landing. Greedy-only
-        (enforced at submit)."""
-        B = len(self.slots)
-        r = self._spec_k + 1
-        steps = max(1, self.ecfg.decode_steps_per_dispatch)
-        tables = np.zeros((B, self.max_pages), np.int32)
-        active_mask = np.zeros((B,), bool)
-        live: List[int] = []
-        for i, s in enumerate(self.slots):
-            if s is None or s.prefilling:
-                continue
-            if s.req.cancelled:
-                self._finish(i, "cancelled")
-                continue
-            # A verify step writes k/v for up to r positions; a slot
-            # without r tokens of page capacity sits the block out (and
-            # is finished with "length" once its in-flight work drains).
-            cap, _ = self._advance_capacity(s, s.kv_len + s.kv_worst)
-            if cap < r:
-                self._starve(i)
-                continue
-            if s.req.max_new_tokens - s.scheduled <= 0:
-                continue
-            live.append(i)
-        if not live:
-            return False
-        if len(live) * 4 <= B:
-            steps = min(steps, 2)  # same low-occupancy latency regime
-        if self._long_prefills and self.ecfg.prefill_decode_k_cap > 0:
-            steps = min(steps, self.ecfg.prefill_decode_k_cap)
-        cap_steps = min(self._advance_capacity(
-            self.slots[i],
-            self.slots[i].kv_len + self.slots[i].kv_worst)[0] // r
-            for i in live)
-        max_rem = max(self.slots[i].req.max_new_tokens
-                      - self.slots[i].scheduled for i in live)
-        steps = self._pick_k(min(steps, max(1, cap_steps)))
-        if max_rem < steps:  # >=1 token commits per step
-            if self._warm_ks:
-                fits = sorted(k for k in self._warm_ks
-                              if max_rem <= k <= steps)
-                steps = fits[0] if fits else steps
-            else:
-                steps = self._pick_k(max(1, max_rem))
-        worst = steps * r
-        metas = []
-        active: List[int] = []
-        while True:
-            shrink_to = None
-            active = []
-            active_mask[:] = False
-            for i in live:
-                s = self.slots[i]
-                if s is None:
-                    continue
-                bound = s.kv_len + s.kv_worst
-                try:
-                    s.seq.ensure(bound + worst)
-                except MemoryError:
-                    # Same shrink rule as the plain path, in units of r
-                    # (each verify step stores up to r positions): count
-                    # free pool pages too, so a slot whose growth must
-                    # come from the pool shrinks instead of starving.
-                    _, avail = self._advance_capacity(s, bound)
-                    if avail >= r and steps > 1:
-                        shrink_to = max(1, avail // r)
-                        break
-                    if avail < r:
-                        self._starve(i)
-                    continue
-                active.append(i)
-                active_mask[i] = True
-                s.no_capacity = False  # capacity proven; undo stale starve
-                tables[i] = s.seq.table_row()
-                metas.append((i, s, bound))
-            if shrink_to is None:
-                break
-            steps = self._pick_k(shrink_to)
-            worst = steps * r
-            metas = []
-        if not active:
-            return False
-        (targets, counts, self._last_tokens, self._dev_lengths,
-         self._history, self.pool) = engine_model.decode_spec_multi_step(
-            self.params, self.cfg, self.pool, self._history,
-            self._last_tokens, self._dev_lengths, self._put(tables),
-            self._put(active_mask), n_steps=steps, k=self._spec_k,
-            use_pallas=self.use_pallas, mesh=self.mesh)
-        for i in active:
-            s = self.slots[i]
-            s.awaiting_first = False
-            s.scheduled += worst
-            s.kv_worst += worst
-        self.metrics.decode_steps += steps
-        self.metrics.busy_slots_acc += len(active) * steps
-        if self._async_block_copy:
-            for b in (targets, counts):
-                try:
-                    b.copy_to_host_async()
-                except AttributeError:
-                    pass
-        self._inflight.append(_InFlight((targets, counts), metas, steps,
-                                        spec_worst=worst))
-        return True
+    # graftlint: hot-path
+    def _select_plan(self, K: int, spec_mode: bool):
+        """Choose the widest WARMED StepPlan for this dispatch: the
+        decode block always runs; the spec-verify width rides on a
+        speculative engine unless a live sampled request forced the
+        plain fallback; a prefill rider attaches when an in-progress
+        chunked prefill's fused variant is warmed for this
+        (S_total, K). Fallback is always toward a NARROWER plan (drop
+        the rider — the interleaved lane carries the chunk this beat)
+        rather than compiling a cold lattice point mid-traffic, which
+        would freeze every live stream for a 20-40 s compile. Returns
+        (plan, rider _LongPrefill or None)."""
+        spec_k = self._spec_k if spec_mode else 0
+        spec_state = bool(self._spec_k) and not spec_mode
+        rider_w = rider_s = 0
+        lp = None
+        if not spec_state:  # the fallback plan has no rider variant
+            cand = self._rider_candidate()
+            if cand is not None:
+                s_total = cand.cache.k.shape[-2]
+                warm = self._warm_spec_fused if spec_k else self._warm_fused
+                # Keyed on _warm_ks (did ANY warmup run), so a warmup
+                # without long_prompts=True — which leaves the fused
+                # sets empty — also refuses, instead of reading
+                # "empty = anything goes".
+                if not self._warm_ks or (s_total, K) in warm:
+                    rider_w, rider_s = self._fused_width, s_total
+                    lp = cand
+        return engine_model.StepPlan(
+            decode_k=K, spec_k=spec_k,
+            tree_branches=self._tree_branches if spec_k else 0,
+            rider_width=rider_w, rider_s_total=rider_s,
+            spec_state=spec_state), lp
+
+    # graftlint: hot-path
+    def _dispatch_plan(self, plan, lp, tables, lengths, active_mask,
+                       temps, top_ps, top_ks, flags):
+        """Lower the selected StepPlan through engine_model.plan_step —
+        ONE fully async jitted dispatch — and fold the returned state
+        back into the engine (pool / device token chain / speculative
+        state / the rider's scratch cache, counters and pacing beat)."""
+        kw = dict(pool=self.pool, last_tokens=self._last_tokens,
+                  page_tables=self._put(tables),
+                  active=self._put(active_mask),
+                  use_pallas=self.use_pallas, mesh=self.mesh)
+        if plan.spec_k or plan.spec_state:
+            kw.update(history=self._history, dev_lengths=self._dev_lengths)
+        if not plan.spec_k:
+            kw.update(lengths=self._put(lengths),
+                      temperature=self._put(temps),
+                      top_p=self._put(top_ps), top_k=self._put(top_ks),
+                      rng=self._next_key(), sampling_flags=flags)
+        part = None
+        if plan.rider_width:
+            part = lp.ids[lp.pos:lp.pos + plan.rider_width]
+            tok = self._chunk_buf(plan.rider_width)
+            tok[0, :len(part)] = part
+            kw.update(cache=lp.cache, chunk_tokens=self._put(tok),
+                      chunk_valid=self._put(np.int32(len(part))))
+        res = engine_model.plan_step(self.params, self.cfg, plan, **kw)
+        self.pool = res["pool"]
+        self._last_tokens = res["last_tokens"]
+        if plan.spec_k or plan.spec_state:
+            self._dev_lengths = res["dev_lengths"]
+            self._history = res["history"]
+        if plan.rider_width:
+            lp.cache = res["cache"]
+            lp.pos += len(part)
+            lp.beat = self._beat  # the rider consumed this beat's chunk
+            self.metrics.fused_steps += 1
+            self.metrics.fused_prefill_tokens += len(part)
+            # Real (unpadded) prompt tokens only — the rider's fixed-
+            # width padding must not inflate the prefill meter.
+            self.metrics.prefill_tokens += len(part)
+            if lp.pos >= len(lp.ids):
+                self._long_prefills.remove(lp)
+                self._finish_long_prefill(lp, res["chunk_logits"])
+        return res
 
     def _pick_k(self, bound: int) -> int:
         """Largest dispatchable K <= bound: power-of-two, and (when a
@@ -1972,7 +2100,10 @@ class LLMEngine:
         (kv_worst -= spec_worst in _process_spec_block) and retiring
         slots free pool pages — so finishing unconditionally here would
         truncate streams with reason "length" while pages are free."""
-        r = (self._spec_k + 1) if self._spec_k else 1
+        # A verify step writes k/v for every packed tree node, so the
+        # revival floor is the full node count (== k+1 on linear/plain
+        # engines — byte-identical to the pre-tree reap rule).
+        r = self._spec_tree_nodes if self._spec_k else 1
         reclaimable_pages = None  # computed at most once per pass: the
         # tree cannot change between iterations of this scheduler loop
         for i, slot in enumerate(self.slots):
@@ -1981,9 +2112,8 @@ class LLMEngine:
             if any(s is slot for fl in self._inflight
                    for _, s, _ in fl.metas):
                 continue
-            used = (slot.kv_len + slot.kv_worst) if self._spec_k \
-                else slot.seq.length
-            table_cap, avail = self._advance_capacity(slot, used)
+            table_cap, avail = self._advance_capacity(
+                slot, self._slot_used(slot))
             if self.prefix_cache is not None and avail < r:
                 # Cold cached pages are reclaimable on demand (the
                 # allocator's reclaim hook evicts inside alloc); a slot
@@ -2033,6 +2163,13 @@ class LLMEngine:
                 self._emit(slot, tok, slot_idx=i)
                 if self.slots[i] is not slot:
                     break  # finished mid-block; rest is overshoot
+            if fl.plain_spec:
+                # Plain block on a speculative engine (sampled-request
+                # fallback): all K tokens always advance, so the
+                # host's reconciled length moves exactly K and the
+                # dispatch-time reservation is released in full.
+                slot.kv_len += fl.K
+                slot.kv_worst -= fl.K
         paced = self._pace_engaged
         self._pace_engaged = False
         end = time.perf_counter()

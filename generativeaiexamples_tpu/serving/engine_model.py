@@ -18,7 +18,7 @@ Both are shape-stable: prefill compiles once per bucket, decode once per
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -568,43 +568,279 @@ def _decode_verify_once(params, cfg: LlamaConfig, pool: PagePool,
     return logits, PagePool(pools[0], pools[1], ps)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "k",
-                                             "use_pallas", "mesh"),
-                   donate_argnames=("pool", "history", "dev_lengths",
-                                    "last_tokens"))
-def decode_spec_multi_step(
-    params, cfg: LlamaConfig, pool: PagePool,
-    history: jax.Array,       # [B, Hcap] device token history
-    last_tokens: jax.Array,   # [B] device-resident current token
-    dev_lengths: jax.Array,   # [B] device-resident lengths incl. current
-    page_tables: jax.Array,   # [B, maxp]
-    active: jax.Array,        # [B] bool
-    n_steps: int, k: int,
-    use_pallas: Optional[bool] = None,
-    mesh=None,
-):
-    """n_steps fused VERIFY steps. Each step drafts k tokens from the
-    history buffer, verifies them in one forward, commits the accepted
-    prefix + one bonus token (>=1 token per step, exactly the greedy
-    continuation), and chains tokens/lengths/history on device.
+def ngram_tree_draft(history: jax.Array, lengths: jax.Array, t0: jax.Array,
+                     k: int, n_branches: int) -> jax.Array:
+    """Multi-branch n-gram lattice draft: branch m proposes the k
+    tokens FOLLOWING the (m+1)-th most recent previous occurrence of
+    the current token t0 — branch 0 is exactly ngram_draft's single
+    chain, extra branches widen the lattice with older continuations
+    of the same context. The LAST branch (when n_branches >= 2) is the
+    longest-suffix match instead: the k tokens after the most recent
+    BIGRAM occurrence (t_{-1}, t0) — prompt-lookup style, a longer
+    context match predicts the continuation better than recency alone —
+    deduplicated against branch 0's site (when the best bigram site IS
+    the most recent unigram site, the next-most-recent bigram site is
+    used so the slot is never a wasted duplicate). Rows/branches
+    without a matching occurrence fall back to repeating t0 (harmless:
+    rejection costs only the verify positions already paid for).
+    Returns [B, n_branches, k]."""
+    B, Hcap = history.shape
+    pos = jnp.arange(Hcap)[None, :]
+    cur = (lengths - 1)[:, None]
+    m = (history == t0[:, None]) & (pos < cur)
+    occ, _ = jax.lax.top_k(jnp.where(m, pos, -1), n_branches)  # [B, M] desc
+    if n_branches >= 2:
+        prev = jnp.take_along_axis(history, jnp.maximum(cur - 1, 0),
+                                   axis=1)                   # [B, 1] t_{-1}
+        hist_prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, history.dtype), history[:, :-1]], axis=1)
+        m2 = m & (hist_prev == prev)
+        occ2, _ = jax.lax.top_k(jnp.where(m2, pos, -1), 2)   # [B, 2] desc
+        best = jnp.where(occ2[:, 0] == occ[:, 0], occ2[:, 1], occ2[:, 0])
+        occ = occ.at[:, n_branches - 1].set(best)
+    has = occ >= 0
+    gidx = jnp.clip(occ[:, :, None] + jnp.arange(1, k + 1)[None, None, :],
+                    0, Hcap - 1)
+    d = jnp.take_along_axis(history, gidx.reshape(B, n_branches * k),
+                            axis=1).reshape(B, n_branches, k)
+    return jnp.where(has[:, :, None], d, t0[:, None, None])
 
-    Returns (targets [B, n_steps, k+1], counts [B, n_steps],
-    last_tokens, dev_lengths, history, pool). The host emits
-    targets[b, s, :counts[b, s]] per landed block; lengths are device-
-    authoritative because the host cannot know acceptance in advance."""
+
+@functools.lru_cache(maxsize=None)
+def _tree_layout(k: int, n_branches: int):
+    """Static packed-tree layout for (depth-k, M-branch) n-gram lattice
+    drafts: node 0 is the root (t0), node 1 + m*k + (d-1) is branch
+    m's depth-d draft. Returns (depth [r], ancestor-or-self mask
+    [r, r]) as plain numpy — tree shape is a compile-time constant of
+    the verify step."""
+    import numpy as np
+
+    r = 1 + n_branches * k
+    depth = np.zeros((r,), np.int32)
+    anc = np.zeros((r, r), bool)
+    anc[0, 0] = True
+    for m in range(n_branches):
+        for d in range(1, k + 1):
+            j = 1 + m * k + (d - 1)
+            depth[j] = d
+            anc[j, 0] = True           # root is everyone's ancestor
+            anc[j, j] = True           # self
+            for d2 in range(1, d):
+                anc[j, 1 + m * k + (d2 - 1)] = True
+    return depth, anc
+
+
+def _tree_verify_once(params, cfg: LlamaConfig, pool: PagePool,
+                      tokens: jax.Array,       # [B, r] packed tree tokens
+                      page_tables: jax.Array,  # [B, maxp]
+                      lengths: jax.Array,      # [B] incl. t0 (root)
+                      depth, anc_mask,         # static layout (_tree_layout)
+                      use_pallas, mesh=None):
+    """One tree-verify forward over r packed tree positions per
+    sequence: node j's k/v is written (write-then-attend) at pool slot
+    lengths-1+j with its ROPE position taken from its tree DEPTH
+    (lengths-1+depth[j]); attention runs the packed tree-attention
+    mask (prefix + ancestor chain) over the gathered pages. Rejected
+    nodes need no cleanup: the committed path is RELOCATED to the
+    packed slots lengths-1 .. lengths-1+acc by _tree_relocate_commit,
+    and everything past the new length is overwritten before it is
+    ever attended (same contract as the linear verify path). Returns
+    (logits [B, r, V], pool).
+
+    The tree mask is inexpressible with length-only masking, so this
+    path always takes the gather-based XLA attention route
+    (paged_tree_attention_reference) — a Pallas tree kernel is future
+    work; linear verify (n_branches <= 1) keeps its fused kernel."""
+    from generativeaiexamples_tpu.serving.paged_attention import (
+        paged_tree_attention_int8_reference_fused,
+        paged_tree_attention_reference)
+
+    B, r = tokens.shape
+    ps = pool.page_size
+    maxp = page_tables.shape[1]
+    KH = cfg.n_kv_heads
+    depth = jnp.asarray(depth, jnp.int32)
+    positions = (lengths - 1)[:, None] + depth[None, :]          # [B, r]
+    slots = (lengths - 1)[:, None] + jnp.arange(r)[None, :]      # [B, r]
+    page_idx = jnp.take_along_axis(
+        page_tables, jnp.clip(slots // ps, 0, maxp - 1), axis=1)
+    offset = slots % ps
+    kh_idx = jnp.arange(KH)[:, None, None]
+
+    x = params["tok_emb"][tokens].astype(cfg.dtype)              # [B, r, D]
+    quantized = pool.quantized
+    if quantized:
+        from generativeaiexamples_tpu.serving.kv_cache import QuantPagePool
+        from generativeaiexamples_tpu.serving.paged_attention_int8 import (
+            quantize_kv)
+
+    def body(x, pools, w, l):
+        h = rms_norm(x, w["ln1"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, h, w, positions)   # [B, *, r, Hd]
+        k_new = k.transpose(1, 0, 2, 3)                # [KH, B, r, Hd]
+        v_new = v.transpose(1, 0, 2, 3)
+        if quantized:
+            kv_pool, s_pool = pools
+            kq, ksc = quantize_kv(k_new, scale_dtype=s_pool.dtype)
+            vq, vsc = quantize_kv(v_new, scale_dtype=s_pool.dtype)
+            kv_pool = kv_pool.at[
+                0, l, kh_idx, page_idx[None], offset[None], :].set(kq)
+            kv_pool = kv_pool.at[
+                1, l, kh_idx, page_idx[None], offset[None], :].set(vq)
+            s_pool = s_pool.at[
+                0, l, kh_idx, page_idx[None], offset[None]].set(ksc)
+            s_pool = s_pool.at[
+                1, l, kh_idx, page_idx[None], offset[None]].set(vsc)
+            out = paged_tree_attention_int8_reference_fused(
+                q, kv_pool[:, l], s_pool[:, l], page_tables, lengths,
+                anc_mask)
+            new_pools = (kv_pool, s_pool)
+        else:
+            k_pool, v_pool = pools
+            k_pool = k_pool.at[
+                l, kh_idx, page_idx[None], offset[None], :].set(
+                k_new.astype(k_pool.dtype))
+            v_pool = v_pool.at[
+                l, kh_idx, page_idx[None], offset[None], :].set(
+                v_new.astype(v_pool.dtype))
+            out = paged_tree_attention_reference(
+                q, k_pool[l], v_pool[l], page_tables, lengths, anc_mask)
+            new_pools = (k_pool, v_pool)
+        x = _finish_block(cfg, x, out, w)              # out [B, H, r, Hd]
+        return x, new_pools
+
+    pools = (pool.kv, pool.s) if quantized else (pool.k, pool.v)
+    if _UNROLL_DECODE:
+        from generativeaiexamples_tpu.ops.quant import QuantizedTensor
+
+        def take(t, l):
+            if isinstance(t, QuantizedTensor):
+                return QuantizedTensor(t.q[l], t.s[l])
+            return t[l]
+
+        for l in range(cfg.n_layers):
+            w = {k2: take(v2, l) for k2, v2 in params["layers"].items()}
+            x, pools = body(x, pools, w, l)
+    else:
+        def scan_body(carry, wl):
+            x, pools = carry
+            w, l = wl
+            return body(x, pools, w, l), None
+
+        (x, pools), _ = jax.lax.scan(
+            scan_body, (x, pools),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    logits = _logits(cfg, params, x)                   # [B, r, V]
+    if quantized:
+        return logits, QuantPagePool(pools[0], pools[1], ps)
+    return logits, PagePool(pools[0], pools[1], ps)
+
+
+def _tree_relocate_commit(pool: PagePool, cfg: LlamaConfig,
+                          page_tables: jax.Array, lengths: jax.Array,
+                          m_star: jax.Array, k: int) -> PagePool:
+    """Move the accepted branch's k/v from its packed tree slots into
+    the sequence's consecutive slots lengths-1 .. lengths-1+k (ONE
+    gather + one scatter over all layers; quantized pools move codes +
+    scales verbatim — no requantization error). Branch 0 is the
+    identity relocation (its nodes already sit at the packed slots),
+    and slots past the accepted prefix hold garbage that the length
+    mask hides until the next step overwrites them."""
+    ps = pool.page_size
+    maxp = page_tables.shape[1]
+    d_ar = jnp.arange(k + 1)[None, :]                       # [1, k+1]
+    src_node = jnp.where(d_ar == 0, 0,
+                         1 + m_star[:, None] * k + d_ar - 1)  # [B, k+1]
+    src_slot = (lengths - 1)[:, None] + src_node
+    dst_slot = (lengths - 1)[:, None] + d_ar
+    src_pi = jnp.take_along_axis(
+        page_tables, jnp.clip(src_slot // ps, 0, maxp - 1), axis=1)
+    dst_pi = jnp.take_along_axis(
+        page_tables, jnp.clip(dst_slot // ps, 0, maxp - 1), axis=1)
+    src_off = src_slot % ps
+    dst_off = dst_slot % ps
+    if pool.quantized:
+        from generativeaiexamples_tpu.serving.kv_cache import QuantPagePool
+
+        L = pool.kv.shape[1]
+        KH = pool.kv.shape[2]
+        kvi = jnp.arange(2)[:, None, None, None, None]
+        li = jnp.arange(L)[None, :, None, None, None]
+        kh = jnp.arange(KH)[None, None, :, None, None]
+        vals = pool.kv[kvi, li, kh, src_pi[None, None, None],
+                       src_off[None, None, None], :]
+        svals = pool.s[kvi, li, kh, src_pi[None, None, None],
+                       src_off[None, None, None]]
+        kv = pool.kv.at[kvi, li, kh, dst_pi[None, None, None],
+                        dst_off[None, None, None], :].set(vals)
+        s = pool.s.at[kvi, li, kh, dst_pi[None, None, None],
+                      dst_off[None, None, None]].set(svals)
+        return QuantPagePool(kv, s, ps)
+    L, KH = pool.k.shape[0], pool.k.shape[1]
+    li = jnp.arange(L)[:, None, None, None]
+    kh = jnp.arange(KH)[None, :, None, None]
+    kvals = pool.k[li, kh, src_pi[None, None], src_off[None, None], :]
+    vvals = pool.v[li, kh, src_pi[None, None], src_off[None, None], :]
+    kp = pool.k.at[li, kh, dst_pi[None, None], dst_off[None, None], :].set(
+        kvals)
+    vp = pool.v.at[li, kh, dst_pi[None, None], dst_off[None, None], :].set(
+        vvals)
+    return PagePool(kp, vp, ps)
+
+
+def _spec_verify_loop(params, cfg: LlamaConfig, pool, history, last_tokens,
+                      dev_lengths, page_tables, active, n_steps: int, k: int,
+                      n_branches: int, use_pallas, mesh):
+    """Shared body of the speculative programs: n_steps fused verify
+    steps (linear chain when n_branches <= 1 — byte-identical to the
+    pre-tree engine — or the packed n-gram lattice tree), chaining
+    tokens/lengths/history on device. Targets/counts keep the SAME
+    [B, n_steps, k+1] shape either way: tree verification widens only
+    the draft lattice, never the committed-tokens contract."""
     B = last_tokens.shape[0]
     Hcap = history.shape[1]
     bi = jnp.arange(B)[:, None]
+    tree = n_branches > 1
+    if tree:
+        depth, anc = _tree_layout(k, n_branches)
     out_t, out_c = [], []
     for _ in range(n_steps):
-        draft = ngram_draft(history, dev_lengths, last_tokens, k)
-        tokens_in = jnp.concatenate([last_tokens[:, None], draft], axis=1)
-        logits, pool = _decode_verify_once(
-            params, cfg, pool, tokens_in, page_tables, dev_lengths,
-            use_pallas, mesh)
-        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, r]
-        ok = (draft == targets[:, :-1])
-        acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+        if tree:
+            draft = ngram_tree_draft(history, dev_lengths, last_tokens,
+                                     k, n_branches)        # [B, M, k]
+            tree_tokens = jnp.concatenate(
+                [last_tokens[:, None], draft.reshape(B, n_branches * k)],
+                axis=1)                                    # [B, r_nodes]
+            logits, pool = _tree_verify_once(
+                params, cfg, pool, tree_tokens, page_tables, dev_lengths,
+                depth, anc, use_pallas, mesh)
+            node_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            t_root = node_t[:, 0]
+            btarg = node_t[:, 1:].reshape(B, n_branches, k)
+            ok = jnp.concatenate(
+                [(draft[:, :, 0] == t_root[:, None])[..., None],
+                 draft[:, :, 1:] == btarg[:, :, :-1]], axis=-1)  # [B,M,k]
+            accm = jnp.cumprod(ok.astype(jnp.int32), axis=-1).sum(axis=-1)
+            m_star = jnp.argmax(accm, axis=-1)             # first max
+            acc = jnp.take_along_axis(accm, m_star[:, None], axis=1)[:, 0]
+            sel_t = jnp.take_along_axis(
+                btarg, m_star[:, None, None], axis=1)[:, 0]  # [B, k]
+            # Every branch accepted at depth d agrees on the committed
+            # token there (same context -> same argmax), so taking the
+            # deepest-accepting branch is still exactly greedy.
+            targets = jnp.concatenate([t_root[:, None], sel_t], axis=1)
+            pool = _tree_relocate_commit(pool, cfg, page_tables,
+                                         dev_lengths, m_star, k)
+        else:
+            draft = ngram_draft(history, dev_lengths, last_tokens, k)
+            tokens_in = jnp.concatenate([last_tokens[:, None], draft],
+                                        axis=1)
+            logits, pool = _decode_verify_once(
+                params, cfg, pool, tokens_in, page_tables, dev_lengths,
+                use_pallas, mesh)
+            targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,r]
+            ok = (draft == targets[:, :-1])
+            acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
         counts = jnp.where(active, acc + 1, 0)
         bonus = jnp.take_along_axis(targets, acc[:, None], axis=1)[:, 0]
         # History gains the committed continuation at positions
@@ -621,6 +857,96 @@ def decode_spec_multi_step(
         out_c.append(counts)
     return (jnp.stack(out_t, axis=1), jnp.stack(out_c, axis=1),
             last_tokens, dev_lengths, history, pool)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "k",
+                                             "n_branches",
+                                             "use_pallas", "mesh"),
+                   donate_argnames=("pool", "history", "dev_lengths",
+                                    "last_tokens"))
+def decode_spec_multi_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    history: jax.Array,       # [B, Hcap] device token history
+    last_tokens: jax.Array,   # [B] device-resident current token
+    dev_lengths: jax.Array,   # [B] device-resident lengths incl. current
+    page_tables: jax.Array,   # [B, maxp]
+    active: jax.Array,        # [B] bool
+    n_steps: int, k: int,
+    n_branches: int = 0,
+    use_pallas: Optional[bool] = None,
+    mesh=None,
+):
+    """n_steps fused VERIFY steps. Each step drafts from the history
+    buffer (a single k-chain, or an M-branch tree lattice when
+    n_branches > 1), verifies in one forward, commits the accepted
+    prefix + one bonus token (>=1 token per step, exactly the greedy
+    continuation), and chains tokens/lengths/history on device.
+
+    Returns (targets [B, n_steps, k+1], counts [B, n_steps],
+    last_tokens, dev_lengths, history, pool). The host emits
+    targets[b, s, :counts[b, s]] per landed block; lengths are device-
+    authoritative because the host cannot know acceptance in advance."""
+    return _spec_verify_loop(params, cfg, pool, history, last_tokens,
+                             dev_lengths, page_tables, active, n_steps, k,
+                             n_branches, use_pallas, mesh)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas",
+                                             "sampling_flags", "mesh"),
+                   donate_argnames=("pool", "history", "dev_lengths",
+                                    "last_tokens"))
+def decode_plain_spec_state_multi_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    history: jax.Array,       # [B, Hcap] device token history
+    last_tokens: jax.Array,   # [B] device-resident current token
+    dev_lengths: jax.Array,   # [B] device-authoritative lengths
+    page_tables: jax.Array,   # [B, maxp]
+    active: jax.Array,        # [B] bool
+    temperature: jax.Array,   # [B]
+    top_p: jax.Array,         # [B]
+    top_k: jax.Array,         # [B]
+    rng: jax.Array,
+    n_steps: int,
+    use_pallas: Optional[bool] = None,
+    sampling_flags: Tuple[bool, bool, bool] = (False, True, True),
+    mesh=None,
+):
+    """Plain (non-speculative) fused decode block over a SPECULATIVE
+    engine's device-authoritative state — the per-request fallback for
+    sampled requests on a speculative engine: greedy verification
+    cannot honor temperature > 0, so dispatches with a live sampled
+    slot run this plan instead (the request serves, it just doesn't
+    speculate). Exactly decode_multi_step's loop, except lengths come
+    from the device (the host cannot know them while speculative
+    blocks are in flight) and every sampled token is appended to the
+    history buffer so later verify steps draft from fresh state.
+
+    Returns (block [B, n_steps+1], last_tokens, dev_lengths, history,
+    pool)."""
+    from generativeaiexamples_tpu.serving.sampling import SamplingParams, sample
+
+    B = last_tokens.shape[0]
+    Hcap = history.shape[1]
+    bi = jnp.arange(B)
+    sp = SamplingParams(temperature, top_p, top_k)
+    all_greedy, any_top_k, any_top_p = sampling_flags
+    tokens = last_tokens
+    out_tokens = [tokens]
+    for _ in range(n_steps):
+        logits, pool = _decode_once(
+            params, cfg, pool, tokens, page_tables, dev_lengths, use_pallas,
+            mesh)
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits, sp, key, all_greedy=all_greedy,
+                     any_top_k=any_top_k, any_top_p=any_top_p)
+        tokens = jnp.where(active, nxt, tokens)
+        out_tokens.append(tokens)
+        hpos = jnp.clip(dev_lengths, 0, Hcap - 1)
+        history = history.at[bi, hpos].set(
+            jnp.where(active, tokens, history[bi, hpos]))
+        dev_lengths = jnp.where(active, dev_lengths + 1, dev_lengths)
+    return (jnp.stack(out_tokens, axis=1), tokens, dev_lengths, history,
+            pool)
 
 
 @functools.partial(jax.jit, donate_argnames=("history", "dev_lengths"))
@@ -764,6 +1090,57 @@ def fused_decode_prefill_step(
     return (jnp.stack(out_tokens, axis=1), tokens, pool, chunk_last, cache)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "k",
+                                             "n_branches", "use_pallas",
+                                             "mesh"),
+                   donate_argnames=("pool", "history", "dev_lengths",
+                                    "last_tokens", "cache"))
+def fused_spec_prefill_step(
+    params, cfg: LlamaConfig, pool: PagePool,
+    history: jax.Array,       # [B, Hcap] device token history
+    last_tokens: jax.Array,   # [B] device-resident current token
+    dev_lengths: jax.Array,   # [B] device-authoritative lengths
+    page_tables: jax.Array,   # [B, maxp]
+    active: jax.Array,        # [B] bool
+    cache,                    # scratch KVCache of the in-progress prefill
+    chunk_tokens: jax.Array,  # [1, W] next prompt chunk (0-padded)
+    chunk_valid: jax.Array,   # [] valid tokens in this chunk
+    n_steps: int, k: int,
+    n_branches: int = 0,
+    use_pallas: Optional[bool] = None,
+    mesh=None,
+):
+    """The composed StepPlan program: n_steps speculative VERIFY steps
+    (linear chain or tree lattice) AND one chunk of an in-progress
+    long prefill in ONE dispatch — the lattice point the lane-
+    exclusive scheduler could never reach (speculative engines used to
+    force every chunk through the standalone interleaved lane,
+    reintroducing exactly the device-queue stall the fused rider
+    closes for plain engines).
+
+    The halves touch disjoint state (verify: page pool + history;
+    chunk: the prefill's contiguous scratch cache) and compute exactly
+    the math of decode_spec_multi_step and prefill_chunk_step.
+    Returns (targets [B, n_steps, k+1], counts [B, n_steps],
+    last_tokens, dev_lengths, history, pool, chunk_logits [V], cache).
+    Compiles per (B, n_steps, W, S_total) — warmup() precompiles the
+    variants live traffic can reach."""
+    from generativeaiexamples_tpu.models import llama
+
+    logits, cache = llama.forward(params, cfg, chunk_tokens, kv_cache=cache,
+                                  lengths=chunk_valid[None],
+                                  use_pallas=use_pallas, mesh=mesh)
+    chunk_last = jnp.take_along_axis(
+        logits, (chunk_valid - 1).reshape(1, 1, 1).astype(jnp.int32),
+        axis=1)[0, 0]
+    (targets, counts, last_tokens, dev_lengths, history,
+     pool) = _spec_verify_loop(params, cfg, pool, history, last_tokens,
+                               dev_lengths, page_tables, active, n_steps, k,
+                               n_branches, use_pallas, mesh)
+    return (targets, counts, last_tokens, dev_lengths, history, pool,
+            chunk_last, cache)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def pool_to_cache(
     pool: PagePool, cfg: LlamaConfig,
@@ -816,3 +1193,111 @@ def cache_to_pool(
     kw = cache.k[:, 0].reshape(L, KH, npages, ps, Hd)
     vw = cache.v[:, 0].reshape(L, KH, npages, ps, Hd)
     return _write_prefill_pages(pool, kw, vw, table_row)
+
+
+# ---------------------------------------------------------------------------
+# Composable step plans: one declarative recipe per device dispatch
+# ---------------------------------------------------------------------------
+
+
+class StepPlan(NamedTuple):
+    """Declarative description of ONE engine device dispatch — the
+    composable recipe every scheduler step is lowered from (the
+    Sarathi-Serve insight: stall-free batching wants each dispatch
+    built from one declarative plan, not from partially-exclusive
+    lanes). Hashable: warmup() records the precompiled plan lattice as
+    a set of these, and dispatch falls back to a NARROWER plan (drop
+    the rider) rather than compiling a cold lattice point mid-traffic.
+
+    decode_k       fused decode / verify outer steps (0 = no decode
+                   half: a rider-only chunk dispatch on an idle lane)
+    spec_k         draft tokens per verify step (0 = plain decode)
+    tree_branches  n-gram lattice branches for tree-verify drafts
+                   (<= 1 = the linear chain)
+    rider_width    prefill-rider token width (0 = no rider)
+    rider_s_total  the rider's scratch-cache length (compile key)
+    spec_state     plain decode over a speculative engine's device-
+                   authoritative state (the sampled-request fallback)
+    """
+
+    decode_k: int = 0
+    spec_k: int = 0
+    tree_branches: int = 0
+    rider_width: int = 0
+    rider_s_total: int = 0
+    spec_state: bool = False
+
+
+def plan_step(params, cfg: LlamaConfig, plan: StepPlan, *,
+              pool=None, last_tokens=None, page_tables=None, lengths=None,
+              active=None, temperature=None, top_p=None, top_k=None,
+              rng=None, history=None, dev_lengths=None, cache=None,
+              chunk_tokens=None, chunk_valid=None,
+              use_pallas: Optional[bool] = None,
+              sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
+              mesh=None) -> dict:
+    """Lower a StepPlan to ONE jitted device program — the single
+    dispatch entry point for every scheduler step. Each lattice point
+    maps to exactly one fused program (the plan IS the compile key),
+    so a warmed plan never recompiles and composition never costs an
+    extra dispatch:
+
+      (K, 0, -, 0)   decode_multi_step
+      (K, 0, -, W)   fused_decode_prefill_step
+      (K, k, -, 0)   decode_spec_multi_step       (linear or tree)
+      (K, k, -, W)   fused_spec_prefill_step      (spec + rider, one jit)
+      (K, 0*, -, 0)  decode_plain_spec_state_multi_step  (*spec_state)
+      (0, 0, -, W)   prefill_chunk_step           (idle-lane chunk)
+
+    Returns a dict of exactly the state the plan touched: "block" or
+    ("targets", "counts"), plus "last_tokens"/"pool" and — per plan —
+    "dev_lengths"/"history" and "chunk_logits"/"cache"."""
+    if plan.decode_k == 0:
+        logits, cache = prefill_chunk_step(
+            params, cfg, cache, chunk_tokens, chunk_valid, use_pallas,
+            mesh=mesh)
+        return {"chunk_logits": logits, "cache": cache}
+    if plan.spec_k:
+        if plan.rider_width:
+            (targets, counts, last_tokens, dev_lengths, history, pool,
+             chunk_logits, cache) = fused_spec_prefill_step(
+                params, cfg, pool, history, last_tokens, dev_lengths,
+                page_tables, active, cache, chunk_tokens, chunk_valid,
+                plan.decode_k, plan.spec_k, n_branches=plan.tree_branches,
+                use_pallas=use_pallas, mesh=mesh)
+            return {"targets": targets, "counts": counts,
+                    "last_tokens": last_tokens, "dev_lengths": dev_lengths,
+                    "history": history, "pool": pool,
+                    "chunk_logits": chunk_logits, "cache": cache}
+        (targets, counts, last_tokens, dev_lengths, history,
+         pool) = decode_spec_multi_step(
+            params, cfg, pool, history, last_tokens, dev_lengths,
+            page_tables, active, n_steps=plan.decode_k, k=plan.spec_k,
+            n_branches=plan.tree_branches, use_pallas=use_pallas, mesh=mesh)
+        return {"targets": targets, "counts": counts,
+                "last_tokens": last_tokens, "dev_lengths": dev_lengths,
+                "history": history, "pool": pool}
+    if plan.spec_state:
+        (block, last_tokens, dev_lengths, history,
+         pool) = decode_plain_spec_state_multi_step(
+            params, cfg, pool, history, last_tokens, dev_lengths,
+            page_tables, active, temperature, top_p, top_k, rng,
+            plan.decode_k, use_pallas, sampling_flags=sampling_flags,
+            mesh=mesh)
+        return {"block": block, "last_tokens": last_tokens,
+                "dev_lengths": dev_lengths, "history": history,
+                "pool": pool}
+    if plan.rider_width:
+        (block, last_tokens, pool, chunk_logits,
+         cache) = fused_decode_prefill_step(
+            params, cfg, pool, last_tokens, page_tables, lengths, active,
+            temperature, top_p, top_k, rng, cache, chunk_tokens,
+            chunk_valid, plan.decode_k, use_pallas,
+            sampling_flags=sampling_flags, mesh=mesh)
+        return {"block": block, "last_tokens": last_tokens, "pool": pool,
+                "chunk_logits": chunk_logits, "cache": cache}
+    block, last_tokens, pool = decode_multi_step(
+        params, cfg, pool, last_tokens, page_tables, lengths, active,
+        temperature, top_p, top_k, rng, plan.decode_k, use_pallas,
+        sampling_flags=sampling_flags, mesh=mesh)
+    return {"block": block, "last_tokens": last_tokens, "pool": pool}

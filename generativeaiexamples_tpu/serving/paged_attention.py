@@ -74,6 +74,86 @@ def paged_attention_reference(
     return out[:, :, 0, :]
 
 
+def _tree_attention_core(q, k, v, lengths, anc_mask, scale):
+    """Shared tree-verify attention math over GATHERED pool rows.
+
+    q [B, H, r, Hd]: r packed tree positions whose k/v were just
+    written (write-then-attend) at pool slots lengths-1 .. lengths-2+r.
+    k/v [B, KH, S, Hd] are the sequence's gathered pages. Node j
+    attends the committed prefix (slots < lengths-1) plus its
+    ancestor-or-self chain inside the tree (anc_mask [r, r], a static
+    bool array — row j marks j's ancestors). Same fp32-softmax recipe
+    as mha_reference so tree targets match the linear verify path's
+    numerics as closely as the mask allows."""
+    B, H, r, Hd = q.shape
+    S = k.shape[2]
+    from generativeaiexamples_tpu.ops.attention import _gqa_expand
+
+    k = _gqa_expand(k, H)
+    v = _gqa_expand(v, H)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    col = jnp.arange(S)[None, :]                 # [1, S]
+    rel = col - (lengths - 1)[:, None]           # [B, S] slot - root slot
+    prefix_ok = rel < 0                          # committed prefix
+    in_tree = (rel >= 0) & (rel < r)
+    anc = jnp.asarray(anc_mask, dtype=bool)      # [r, r] static
+    anc_cols = anc[:, jnp.clip(rel, 0, r - 1)]   # [r, B, S]
+    tree_ok = in_tree[:, None, :] & anc_cols.transpose(1, 0, 2)  # [B, r, S]
+    mask = (prefix_ok[:, None, :] | tree_ok)[:, None, :, :]      # [B,1,r,S]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_tree_attention_reference(
+    q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+    page_table: jax.Array, lengths: jax.Array, anc_mask, *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Tree-verify attention over the bf16 page pool (any backend):
+    gather-based like paged_attention_reference, plus the packed
+    tree-attention mask (see _tree_attention_core). There is no Pallas
+    tree kernel yet — the tree-verify path always takes this XLA
+    route, on TPU included."""
+    B, H, r, Hd = q.shape
+    KH = k_pages.shape[0]
+    ps = k_pages.shape[2]
+    maxp = page_table.shape[1]
+    scale = scale if scale is not None else Hd ** -0.5
+    k = k_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
+        B, KH, maxp * ps, Hd)
+    v = v_pages[:, page_table].transpose(1, 0, 2, 3, 4).reshape(
+        B, KH, maxp * ps, Hd)
+    return _tree_attention_core(q, k, v, lengths, anc_mask, scale)
+
+
+def paged_tree_attention_int8_reference_fused(
+    q: jax.Array, kv_pages: jax.Array, kv_scales: jax.Array,
+    page_table: jax.Array, lengths: jax.Array, anc_mask, *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Tree-verify twin over ONE layer's fused int8 pool slice
+    ([2, KH, P, ps, Hd] codes + [2, KH, P, ps] narrow scales):
+    gather-THEN-dequantize — only the batch's pages are ever widened
+    to f32, never the whole pool (the whole-pool dequant of the int8
+    oracle would be a multi-GB materialization per layer here)."""
+    B, H, r, Hd = q.shape
+    KH = kv_pages.shape[1]
+    ps = kv_pages.shape[3]
+    maxp = page_table.shape[1]
+    scale = scale if scale is not None else Hd ** -0.5
+
+    def deq(i):
+        codes = kv_pages[i][:, page_table]          # [KH, B, maxp, ps, Hd]
+        s = kv_scales[i][:, page_table]             # [KH, B, maxp, ps]
+        x = codes.astype(jnp.float32) * s[..., None].astype(jnp.float32)
+        return x.transpose(1, 0, 2, 3, 4).reshape(B, KH, maxp * ps, Hd)
+
+    return _tree_attention_core(q, deq(0), deq(1), lengths, anc_mask, scale)
+
+
 # ---------------------------------------------------------------------------
 # In-repo Pallas kernel (single page per grid step; interpret-friendly)
 # ---------------------------------------------------------------------------

@@ -14,7 +14,11 @@
 #                   when ruff isn't installed in the image.
 #   3. config-docs drift — docs/configuration.md must match
 #                   config/schema.py (scripts/gen_config_docs.py --check).
-#   4. tier-1 tests — the ROADMAP.md pytest gate.
+#   4. step-plan smoke — CPU gate for the composed fused+spec StepPlan
+#                   path (scripts/smoke_plan_step.py: riders carry the
+#                   whole prompt, tree drafts > 1 token/verify-step,
+#                   byte-equality vs offline greedy).
+#   5. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -36,6 +40,9 @@ step "config docs drift (scripts/gen_config_docs.py --check)"
 python scripts/gen_config_docs.py --check || fail=1
 
 if [ "${1:-}" != "--fast" ]; then
+    step "step-plan smoke (JAX_PLATFORMS=cpu scripts/smoke_plan_step.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_plan_step.py || fail=1
+
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -109,9 +109,11 @@ def test_health_and_metrics_surface_prefix_cache_counters():
 
 
 def test_health_and_metrics_surface_fused_counters(server):
-    """The fused-prefill counters are always present: /health carries
-    the section (enabled=false, zeros) and /metrics reports the keys as
-    0 — never absent — when engine.fused_prefill is off."""
+    """The fused-prefill AND step-plan/speculation counters are always
+    present: /health carries the section (enabled=false, zeros) and
+    /metrics reports every key as 0 — never absent — when the knobs
+    are off (the PR-5 counter convention; spec_tokens_per_step used to
+    vanish whenever spec_slot_steps was zero)."""
     async def body(c):
         h = await (await c.get("/health")).json()
         m = await (await c.get("/metrics")).json()
@@ -124,6 +126,9 @@ def test_health_and_metrics_surface_fused_counters(server):
     assert m["fused_steps"] == 0
     assert m["fused_prefill_tokens"] == 0
     assert m["prefill_stall_beats"] == 0
+    assert m["spec_tokens_per_step"] == 0
+    assert m["plan_variants_compiled"] == 0
+    assert m["spec_fallback_steps"] == 0
 
 
 def test_chat_completion_non_streaming(server):
@@ -196,8 +201,9 @@ def test_embedding_engine_batching_order():
 
 def test_speculative_engine_serving_surface():
     """The OpenAI surface over a speculative engine: greedy requests
-    serve normally; sampled requests get an OpenAI-style 422 with an
-    actionable message (not a 500)."""
+    serve normally AND sampled requests serve through the per-request
+    plain-plan fallback (they used to 422; now they just don't
+    speculate — metrics.spec_fallback_steps records the demotions)."""
     tk = ByteTokenizer()
     llm = LLMEngine(
         llama.init_params(TINY_LLM, jax.random.PRNGKey(0)), TINY_LLM, tk,
@@ -209,17 +215,19 @@ def test_speculative_engine_serving_surface():
             ok = await c.post("/v1/chat/completions", json={
                 "messages": [{"role": "user", "content": "hello"}],
                 "max_tokens": 5, "temperature": 0})
-            bad = await c.post("/v1/chat/completions", json={
+            sampled = await c.post("/v1/chat/completions", json={
                 "messages": [{"role": "user", "content": "hello"}],
                 "max_tokens": 5, "temperature": 0.8})
-            return (ok.status, await ok.json(), bad.status,
-                    await bad.json())
+            m = await (await c.get("/metrics")).json()
+            return (ok.status, await ok.json(), sampled.status,
+                    await sampled.json(), m)
 
-        s_ok, d_ok, s_bad, d_bad = _client_call((llm, None, None), body)
+        s_ok, d_ok, s_sm, d_sm, m = _client_call((llm, None, None), body)
         assert s_ok == 200
         assert d_ok["usage"]["completion_tokens"] == 5
-        assert s_bad == 422
-        assert d_bad["error"]["code"] == "unsupported_parameter"
-        assert "speculative" in d_bad["error"]["message"]
+        assert s_sm == 200
+        assert d_sm["usage"]["completion_tokens"] == 5
+        assert m["spec_fallback_steps"] > 0
+        assert "spec_tokens_per_step" in m
     finally:
         llm.stop()

@@ -435,12 +435,29 @@ class TestSpeculativeDecode:
         finally:
             eng.stop()
 
-    def test_sampled_request_rejected(self):
+    def test_sampled_request_falls_back_not_rejected(self):
+        """Sampled requests on a speculative engine are served through
+        the per-request plain-plan fallback (spec-state decode), not
+        rejected: the stream completes with the requested token count,
+        the fallback counter moves, and a greedy request issued
+        afterwards still matches offline greedy exactly (verify plans
+        resume once no sampled slot is live)."""
         eng = self._engine().start()
         try:
-            with pytest.raises(ValueError, match="greedy-only|greedy self"):
-                eng.submit(GenRequest(prompt_ids=[1, 2],
-                                      temperature=0.7))
+            got = [e["token_id"] for e in eng.generate_stream(
+                [1, 2], max_new_tokens=7, temperature=0.7, top_p=0.9)
+                if e["token_id"] >= 0]
+            assert len(got) == 7
+            assert eng.metrics.spec_fallback_steps > 0
+            snap = eng.metrics.snapshot()
+            assert "spec_fallback_steps" in snap
+            prompt = [10, 11, 12, 13, 14]
+            greedy = [e["token_id"] for e in
+                      eng.generate_stream(prompt, max_new_tokens=9)
+                      if e["token_id"] >= 0]
+            want = np.asarray(llama.greedy_generate(
+                eng.params, TINY, jnp.asarray([prompt]), 9))[0, len(prompt):]
+            np.testing.assert_array_equal(greedy, want)
         finally:
             eng.stop()
 
