@@ -22,7 +22,11 @@ BENCH_FUSED (0 skips),
 BENCH_PREFIX (0 skips), BENCH_ENCODERS (0 skips), BENCH_ANN (0 skips;
 BENCH_ANN_N / _DIM / _NLIST / _NPROBE tune the corpus and index),
 BENCH_CONCURRENT (0 skips; BENCH_CONCURRENT_THREADS / _REQS / _N
-tune caller count, requests per caller, corpus size).
+tune caller count, requests per caller, corpus size),
+BENCH_FLEET (0 skips; BENCH_FLEET_REPLICAS / _REQS / _THREADS /
+_PROMPT / _GEN / _CONVS tune replica count and the burst /
+conversation-replay workloads — the scenario runs in a child process
+pinned to the CPU backend, see scripts/bench_fleet.py).
 
 Flags: --repeat N runs the headline decode burst N times and reports
 the MEDIAN as the headline value, with per-run values and spread under
@@ -71,13 +75,30 @@ Scenario output keys (under "extras"):
                  the serving/batcher.py cross-request micro-batcher vs
                  the same load with the batcher off — the Triton
                  dynamic-batcher role; BENCH_CONCURRENT=0 skips)
+  serving fleet: fleet_single_tok_s, fleet_agg_tok_s, fleet_speedup,
+                 fleet_qps_single, fleet_qps, fleet_ttft_p99_1rep_ms,
+                 fleet_ttft_p99_ms, fleet_router_hit_rate,
+                 fleet_hit_tokens, fleet_cold_ttft_ms,
+                 fleet_warm_ttft_ms, fleet_replicas, fleet_cpu_count
+                 (uniform burst through 1 engine vs
+                 BENCH_FLEET_REPLICAS emulated replicas behind the
+                 prefix-locality router, + a two-turn conversation
+                 replay for router hit-rate and warm-vs-cold TTFT —
+                 serving/fleet.py + serving/router.py. Runs as a CPU-
+                 backend child process: replica scaling needs host
+                 cores, not a second chip; on a 1-core container
+                 fleet_speedup honestly reads contention, keyed by
+                 fleet_cpu_count. BENCH_FLEET=0 skips)
 
 `python bench.py --help` prints this header and exits.
 
 Sibling tooling (same checkout):
   scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_microbatch.py /
-  smoke_fused_step.py / smoke_plan_step.py
+  smoke_fused_step.py / smoke_plan_step.py / smoke_router.py
       targeted CPU smoke gates for the serving subsystems
+  scripts/bench_fleet.py
+      the fleet scenario as a standalone CPU tool (multi-replica
+      aggregate throughput + router hit-rate)
   python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/
       graftlint static analysis (trace purity, lock discipline, thread
       hygiene, host-sync, config drift; docs/static_analysis.md) —
@@ -466,6 +487,20 @@ def main() -> None:
             concurrent_stats = {"concurrent_error":
                                 f"{type(e).__name__}: {e}"}
 
+    # -- serving fleet: N data-parallel replicas behind the prefix-
+    # locality router (ISSUE 7 tentpole — aggregate throughput must
+    # scale with replicas, and conversation turns must land on the
+    # replica holding their KV). Runs in a CHILD process pinned to the
+    # CPU backend: replicas-per-chip would serialize on this process's
+    # one device and measure nothing, while threads-on-CPU engines
+    # scale with host cores (fleet_cpu_count keys the reading).
+    fleet_stats = {}
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        try:
+            fleet_stats = _bench_fleet()
+        except Exception as e:
+            fleet_stats = {"fleet_error": f"{type(e).__name__}: {e}"}
+
     tps = statistics.median(tps_runs)
     out = {
         "metric": f"decode_tokens_per_sec_per_chip_llama3_{model}"
@@ -506,6 +541,7 @@ def main() -> None:
             **encoder_stats,
             **ann_stats,
             **concurrent_stats,
+            **fleet_stats,
         },
     }
     # Provenance is pinned: the scenario refuses to emit an artifact
@@ -518,6 +554,26 @@ def main() -> None:
     assert len(out["extras"]["headline_runs_wall_s"]) == repeat
     assert out["extras"]["headline_repeat"] == repeat
     print(json.dumps(out))
+
+
+def _bench_fleet():
+    """Spawn scripts/bench_fleet.py on the CPU backend and merge its
+    one-line JSON result (BENCH_FLEET_* env knobs pass through)."""
+    import subprocess
+    import sys as _sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "bench_fleet.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([_sys.executable, script], env=env,
+                          capture_output=True, text=True, timeout=1200)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+        return {"fleet_error": f"bench_fleet.py rc={proc.returncode}: "
+                               f"{tail}"}
+    return json.loads(lines[-1])
 
 
 def _p95_ms(v):

@@ -323,6 +323,45 @@ class ServingConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Serving fleet: N data-parallel engine replicas behind a
+    prefix-locality router (serving/fleet.py + serving/router.py).
+    Placement scores prefix-cache locality (per-replica shadow radix
+    trees fed by the engines' admission/eviction reports), queue
+    depth, and session affinity, with health-based eviction and
+    graceful drain. Default off (replicas=1, no replica_urls): the
+    single-engine server path is byte-identical to a fleet-less
+    build."""
+
+    # Local (in-process) engine replicas built by the server launcher.
+    # 1 = no fleet at all. >1 emulates data parallelism in one process
+    # (CPU tests/bench; multi-chip hosts give each engine a slice).
+    replicas: int = 1
+    # Comma-separated base URLs of REMOTE engine-server processes
+    # (process-per-replica over the mesh/DCN data axis: each replica
+    # runs `python -m generativeaiexamples_tpu.serving` on its own
+    # host/slice; this process routes and proxies SSE). Non-empty
+    # enables fleet mode even with replicas=1.
+    replica_urls: str = ""
+    # prefix = locality + load + affinity scoring (the default);
+    # least_load and round_robin are the degraded comparison policies.
+    router_policy: str = "prefix"
+    # How long a session (OpenAI `user` field / x-session-id header)
+    # stays pinned to the replica that served it.
+    affinity_ttl_s: float = 300.0
+    # Queue-depth penalty in TOKENS per queued request when scoring a
+    # locality hit: a cached prefix stops winning once its replica is
+    # matched_tokens/load_penalty_tokens requests deeper than the
+    # shallowest one.
+    load_penalty_tokens: int = 256
+    # Per-replica shadow-tree budget (pages of page_size tokens).
+    shadow_capacity_pages: int = 4096
+    # Health-probe period for the background prober; 0 disables the
+    # thread (check_health() can still be called explicitly).
+    health_interval_s: float = 10.0
+
+
+@dataclass(frozen=True)
 class TracingConfig:
     """OTel export settings (parity: common/tracing.py, ENABLE_TRACING)."""
 
@@ -347,6 +386,7 @@ class AppConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
 
 

@@ -43,6 +43,12 @@ HOT_DEFAULTS = {
                   "_select_plan", "_dispatch_plan", "_rider_candidate",
                   "_advance_long_prefills", "_emit_ready_first_tokens"},
     "batcher.py": {"_loop", "_run", "_take_group"},
+    # The fleet request path (serving/router.py + serving/fleet.py):
+    # placement and the per-event stream hook run on server request /
+    # engine scheduler threads — a host sync there stalls every
+    # replica's dispatch, not just one engine's.
+    "router.py": {"place", "_choose", "_score", "_apply_reports"},
+    "fleet.py": {"submit", "_on_event"},
 }
 DEVICE_NAME_RE = re.compile(r"(^|_)dev(_|$)|device", re.IGNORECASE)
 NUMPY_MODULES = ("np", "numpy", "onp")

@@ -36,6 +36,21 @@ def build_engines(cfg, model_size: str = "tiny"):
     from generativeaiexamples_tpu.serving.engine import LLMEngine
     from generativeaiexamples_tpu.utils.tokenizer import load_tokenizer
 
+    # Router-only fleet process (fleet.replicas=0 + replica_urls): no
+    # local engine at all — each replica is its own engine-server
+    # process on its own host/slice (the mesh/DCN data-parallel axis
+    # as processes; each may be TP internally), this process places
+    # requests by prefix locality and proxies the SSE streams.
+    urls = (cfg.fleet.replica_urls or "").strip()
+    if urls and cfg.fleet.replicas <= 0:
+        from generativeaiexamples_tpu.serving.fleet import build_fleet
+
+        tokenizer = (load_tokenizer(cfg.engine.weights_path)
+                     if cfg.engine.weights_path else load_tokenizer("byte"))
+        fleet = build_fleet(cfg, engines=None, tokenizer=tokenizer).start()
+        logging.info("router-only fleet over %s", urls)
+        return fleet, None, None
+
     maybe_initialize_distributed()
     # Multi-chip: build the mesh from config (default MeshConfig puts all
     # devices on the tensor axis — TP serving, the NIM INFERENCE_GPU_COUNT
@@ -74,13 +89,29 @@ def build_engines(cfg, model_size: str = "tiny"):
             params = shd.shard_llama_params(params, lcfg, mesh)
         logging.info("llama params sharded over mesh %s", dict(mesh.shape))
 
-    llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh)
+    n_replicas = max(1, cfg.fleet.replicas)
+    if n_replicas > 1 or urls:
+        # Data-parallel fleet: N engines share the (read-only) params
+        # but own their page pools, prefix caches and scheduler
+        # threads; the prefix-locality router fronts them behind the
+        # same engine-shaped surface, so the OpenAI server below is
+        # unchanged. Remote replicas from fleet.replica_urls join the
+        # same router.
+        from generativeaiexamples_tpu.serving.fleet import build_fleet
+
+        engines = [LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh)
+                   for _ in range(n_replicas)]
+        llm = build_fleet(cfg, engines=engines, tokenizer=tokenizer)
+    else:
+        llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh)
     if os.environ.get("ENGINE_WARMUP", "1") != "0":
         # Precompile prefill/decode variants so the first multi-request
         # burst never stalls live streams behind a compile; the
         # persistent compile cache makes later boots cheap. Sampled
         # variants warm too — temperature>0 is the API default, so the
-        # first real request must not eat the compile.
+        # first real request must not eat the compile. (Fleet: the
+        # jitted steps are module-level, so replica 2..N reuse replica
+        # 1's compilations.)
         llm.warmup(sampled=True,
                    long_prompts=os.environ.get("ENGINE_WARMUP_LONG",
                                                "0") == "1")

@@ -99,6 +99,10 @@ class GenRequest:
         default_factory=queue.Queue)
     submit_time: float = dataclasses.field(default_factory=time.perf_counter)
     request_id: str = ""
+    # Session identity for fleet routing (OpenAI `user` field /
+    # x-session-id header): the router pins a session to the replica
+    # holding its conversation KV. Unused by a single engine.
+    session_id: str = ""
     cancelled: bool = False  # set by the server on client disconnect/stop
     truncate_prompt: bool = False  # opt-in: clamp instead of reject
     trace_context: Any = None  # OTel context from the caller (W3C)
@@ -323,6 +327,17 @@ class EngineMetrics:
             "plan_variants_compiled": self.plan_variants_compiled,
             "spec_fallback_steps": self.spec_fallback_steps,
         }
+        # Fleet-router counters (serving/router.py): a single engine
+        # never routes, but the keys are ALWAYS present — 0/{}, never
+        # absent — so dashboards read one schema whether /metrics is
+        # served by an engine or a fleet (which overrides these with
+        # real values). One shared key list; drift cannot desync the
+        # two sides.
+        from generativeaiexamples_tpu.serving.router import (
+            ROUTER_COUNTER_KEYS)
+
+        out.update(dict.fromkeys(ROUTER_COUNTER_KEYS, 0))
+        out["router_queue_depth"] = {}
         return out
 
 

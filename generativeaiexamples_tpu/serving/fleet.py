@@ -1,0 +1,708 @@
+"""Serving fleet: N data-parallel engine replicas behind one router.
+
+The production topology for heavy traffic (ROADMAP open item 1): one
+`LLMEngine` saturates one chip (or one TP slice); the fleet runs N of
+them data-parallel and places requests by prefix-cache locality, queue
+depth and session affinity (serving/router.py). Two replica flavors:
+
+- `LocalReplica` — an in-process engine (its own scheduler/reader
+  threads, page pool and prefix cache). CPU tests and the bench
+  emulate a fleet this way; on a multi-chip host each engine can own
+  its own device slice.
+- `HttpReplica` — a separate engine-server PROCESS reached over the
+  OpenAI surface (each replica runs `python -m
+  generativeaiexamples_tpu.serving` on its own host/slice — the
+  mesh/DCN data-parallel axis as processes). The router process runs
+  with `fleet.replica_urls` set and no local engine; streams are
+  SSE-proxied through unchanged. Each replica process can itself be
+  tensor-parallel over its slice — the existing `parallel/mesh.py`
+  path composes underneath.
+
+`EngineFleet` exposes the SAME surface the OpenAI server consumes from
+a single engine (`submit` / `tokenizer` / `metrics.snapshot()` /
+`stop`), so `serving/openai_server.py` serves a fleet with zero
+handler changes and SSE streaming is untouched: `submit()` places the
+request on a replica and events flow through `req.stream` exactly as
+before. With `fleet.replicas = 1` (the default) no fleet object is
+built at all — the single-engine path is byte-identical.
+
+Request tracking: `submit()` swaps `req.stream` for a `_TrackedStream`
+whose `put` observes every event, so the fleet knows per-replica queue
+depth and in-flight token load without touching engine internals, can
+requeue not-yet-started requests when a replica is evicted, and can
+wait for in-flight streams during graceful drain.
+
+Lifecycle:
+
+- drain(rid): replica stops admitting, in-flight streams finish,
+  router drops its shadow tree (rebalance). restore(rid) re-admits.
+- health: a daemon probe thread checks each replica every
+  `fleet.health_interval_s` (engine threads alive for local replicas,
+  GET /health for remote ones); a failed replica is EVICTED — removed
+  from placement, not-yet-started requests requeued onto the
+  survivors, mid-stream requests terminated with an error event
+  (their tokens are on the dead replica; replaying a half-delivered
+  stream would duplicate output).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from generativeaiexamples_tpu.serving.router import PrefixLocalityRouter
+
+_LOG = logging.getLogger(__name__)
+
+_COUNTER_KEYS = (
+    "tokens_generated", "decode_steps", "prefill_tokens", "fused_steps",
+    "fused_prefill_tokens", "prefill_stall_beats", "prefix_hits",
+    "prefix_miss", "prefix_evictions", "prefix_hit_tokens",
+    "plan_variants_compiled", "spec_fallback_steps",
+)
+
+
+class FleetUnavailableError(RuntimeError):
+    """No replica admits requests (all draining/evicted) — the server
+    maps this to 503, not 422: the request is fine, the fleet isn't."""
+
+
+def _error_event():
+    """Terminal error event in the engine's stream-event schema (one
+    builder — the server reads exactly these keys)."""
+    return {"text": "", "token_id": -1, "finished": True,
+            "finish_reason": "error"}
+
+
+def sse_json_events(lines):
+    """Decode an SSE byte-line iterable into JSON payloads, stopping at
+    the [DONE] sentinel. Shared by HttpReplica's stream proxy and its
+    tests (no network needed to cover the parser)."""
+    for raw in lines:
+        line = raw.decode("utf-8", "replace").strip()
+        if not line.startswith("data:"):
+            continue
+        data = line[len("data:"):].strip()
+        if data == "[DONE]":
+            return
+        yield json.loads(data)
+
+
+class LocalReplica:
+    """One in-process LLMEngine as a fleet replica."""
+
+    # Eviction may requeue this replica's untouched requests: stop()
+    # JOINS the engine threads, so after it returns nothing can emit
+    # into a stream the fleet re-places.
+    supports_requeue = True
+
+    def __init__(self, rid: str, engine):
+        self.rid = rid
+        self.engine = engine
+        self.state = "active"  # active | draining | evicted (fleet-owned)
+
+    @property
+    def has_prefix_cache(self) -> bool:
+        return getattr(self.engine, "prefix_cache", None) is not None
+
+    def set_reporter(self, fn) -> None:
+        if self.has_prefix_cache:
+            self.engine.prefix_cache.reporter = fn
+
+    def submit(self, req) -> None:
+        self.engine.submit(req)
+
+    def healthy(self) -> bool:
+        t = getattr(self.engine, "_thread", None)
+        return bool(getattr(self.engine, "_running", False)
+                    and t is not None and t.is_alive())
+
+    def start(self) -> None:
+        # Keyed on _running, not _thread: stop() leaves the joined
+        # thread object behind, and restore() after an eviction must
+        # actually restart the scheduler (the engine parks between
+        # iterations, so its slot/page state survives a stop/start).
+        if not getattr(self.engine, "_running", False):
+            self.engine.start()
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+    def purge_waiting(self) -> None:
+        """Forget requests still queued on a stopped engine: eviction
+        moved (or error-terminated) every one of them, so restore()
+        must revive an EMPTY scheduler — a surviving deque entry would
+        replay into a stream another replica now owns."""
+        with self.engine._lock:
+            self.engine.waiting.clear()
+
+    def warmup(self, **kw) -> None:
+        self.engine.warmup(**kw)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self.engine.metrics.snapshot()
+
+
+class HttpReplica:
+    """One remote engine-server process as a fleet replica (the
+    process-per-replica topology). Streams proxy over the replica's
+    /v1/completions SSE surface; prompts travel pre-tokenized (the
+    completions endpoint accepts token-id lists), so router and
+    replica must share one tokenizer. Proxied events carry token_id 0
+    per text chunk (the remote stream is text-granular), so fleet
+    token accounting counts chunks for remote replicas — a load
+    signal, not an exact token count."""
+
+    # Eviction must NOT requeue this replica's requests: the proxy
+    # thread may be parked in urlopen for up to timeout_s and stop()
+    # cannot join it, so a zombie proxy could later inject events into
+    # a stream a survivor now owns. Untouched requests end with an
+    # error event instead (the client retries).
+    supports_requeue = False
+
+    def __init__(self, rid: str, base_url: str, timeout_s: float = 300.0):
+        self.rid = rid
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.state = "active"
+        self.has_prefix_cache = False  # reports can't cross processes
+
+    def set_reporter(self, fn) -> None:
+        """Remote caches report nothing; the router self-feeds this
+        replica's shadow tree at placement time instead."""
+
+    def submit(self, req) -> None:
+        threading.Thread(target=self._proxy, args=(req,), daemon=True,
+                         name=f"fleet-proxy-{self.rid}").start()
+
+    def _proxy(self, req) -> None:
+        body = json.dumps({
+            "prompt": list(req.prompt_ids),
+            "max_tokens": req.max_new_tokens,
+            "temperature": req.temperature, "top_p": req.top_p,
+            "top_k": req.top_k, "stream": True,
+        }).encode()
+        http_req = urllib.request.Request(
+            self.base_url + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        finished = False
+        try:
+            with urllib.request.urlopen(http_req,
+                                        timeout=self.timeout_s) as resp:
+                for ev in sse_json_events(resp):
+                    if req.cancelled:
+                        # Client disconnect / stop-string cut: breaking
+                        # out closes the response, which cancels decode
+                        # on the remote replica (its server sees the
+                        # reset); the terminal event below still closes
+                        # the fleet's tracking record, mirroring the
+                        # local engine's _finish(..., "cancelled").
+                        req.stream.put({"text": "", "token_id": -1,
+                                        "finished": True,
+                                        "finish_reason": "cancelled"})
+                        return
+                    ch = (ev.get("choices") or [{}])[0]
+                    text = ch.get("text", "")
+                    if text:
+                        req.stream.put({"text": text, "token_id": 0,
+                                        "finished": False,
+                                        "finish_reason": None})
+                    if ch.get("finish_reason"):
+                        req.stream.put({"text": "", "token_id": -1,
+                                        "finished": True,
+                                        "finish_reason":
+                                            ch["finish_reason"]})
+                        finished = True
+                        break
+        except Exception as e:
+            _LOG.warning("fleet replica %s stream proxy failed: %s",
+                         self.rid, e)
+        if not finished:
+            req.stream.put(_error_event())
+
+    def healthy(self) -> bool:
+        try:
+            with urllib.request.urlopen(self.base_url + "/health",
+                                        timeout=5.0) as resp:
+                return json.load(resp).get("status") == "healthy"
+        except Exception:
+            return False
+
+    def start(self) -> None:
+        """Remote process owns its own lifecycle."""
+
+    def stop(self) -> None:
+        """Remote process owns its own lifecycle."""
+
+    def warmup(self, **kw) -> None:
+        """Remote process warms itself at boot."""
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        try:
+            with urllib.request.urlopen(self.base_url + "/metrics",
+                                        timeout=5.0) as resp:
+                return json.load(resp)
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+
+class _ReqRecord:
+    __slots__ = ("req", "rid", "est", "emitted", "started", "done",
+                 "submitted")
+
+    def __init__(self, req, rid: str):
+        self.req = req
+        self.rid = rid
+        self.est = max(1, int(getattr(req, "max_new_tokens", 1) or 1))
+        self.emitted = 0      # tokens delivered so far
+        self.started = False  # any event delivered (requeue gate)
+        self.done = False
+        # replica.submit() returned: evict() may take this record over;
+        # until then a racing evict leaves it for submit() to rescue.
+        self.submitted = False
+
+
+class _TrackedStream(queue.Queue):
+    """Drop-in for GenRequest.stream that lets the fleet observe every
+    event (queue depth, in-flight tokens, drain completion) without
+    touching engine internals. put() is called by engine scheduler/
+    pacer threads; the hook must stay cheap."""
+
+    def __init__(self, fleet: "EngineFleet", rec: _ReqRecord):
+        super().__init__()
+        self._fleet = fleet
+        self._rec = rec
+
+    def put(self, item, *a, **kw):  # noqa: D102 - queue.Queue contract
+        if isinstance(item, dict):
+            self._fleet._on_event(self._rec, item)
+        super().put(item, *a, **kw)
+
+
+class _FleetPrefixCacheView:
+    """Aggregate `prefix_cache` facade for /health (n_cached_pages
+    summed over local replicas that run a real cache)."""
+
+    def __init__(self, engines: List):
+        self._engines = engines
+
+    @property
+    def n_cached_pages(self) -> int:
+        return sum(e.prefix_cache.n_cached_pages for e in self._engines)
+
+
+class FleetMetrics:
+    """Engine-shaped metrics facade over the whole fleet: snapshot()
+    aggregates replica counters and merges the router's own, and the
+    attribute surface /health reads (prefix_*, fused_*) sums across
+    local replicas."""
+
+    def __init__(self, fleet: "EngineFleet"):
+        self._fleet = fleet
+
+    def _sum(self, attr: str) -> int:
+        return sum(getattr(r.engine.metrics, attr)
+                   for r in self._fleet.local_replicas())
+
+    prefix_hits = property(lambda self: self._sum("prefix_hits"))
+    prefix_miss = property(lambda self: self._sum("prefix_miss"))
+    prefix_evictions = property(lambda self: self._sum("prefix_evictions"))
+    prefix_hit_tokens = property(
+        lambda self: self._sum("prefix_hit_tokens"))
+    fused_steps = property(lambda self: self._sum("fused_steps"))
+    fused_prefill_tokens = property(
+        lambda self: self._sum("fused_prefill_tokens"))
+    prefill_stall_beats = property(
+        lambda self: self._sum("prefill_stall_beats"))
+
+    def snapshot(self) -> Dict[str, Any]:
+        reps = self._fleet.replicas
+        if any(not isinstance(r, LocalReplica) for r in reps):
+            # Remote snapshots are HTTP round trips (5 s timeout each):
+            # fetch them concurrently so one dead replica costs one
+            # timeout per scrape, not one per replica, serially.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(reps))) as ex:
+                snaps = list(ex.map(lambda r: r.metrics_snapshot(), reps))
+        else:
+            snaps = [r.metrics_snapshot() for r in reps]
+        per_replica = {r.rid: s for r, s in zip(reps, snaps)}
+        out: Dict[str, Any] = {k: 0 for k in _COUNTER_KEYS}
+        occ_num = occ_den = 0.0
+        tps = 0.0
+        spec_num = spec_den = 0.0
+        for snap in per_replica.values():
+            for k in _COUNTER_KEYS:
+                out[k] += snap.get(k) or 0
+            steps = snap.get("decode_steps") or 0
+            occ_num += (snap.get("mean_batch_occupancy") or 0.0) * steps
+            occ_den += steps
+            tps += snap.get("tokens_per_sec") or 0.0
+            spec_num += (snap.get("spec_tokens_per_step") or 0.0) * steps
+            spec_den += steps
+        out["mean_batch_occupancy"] = occ_num / occ_den if occ_den else 0.0
+        out["tokens_per_sec"] = tps
+        out["spec_tokens_per_step"] = (spec_num / spec_den
+                                       if spec_den else 0.0)
+        # TTFT percentiles merge raw samples (local replicas only —
+        # remote snapshots expose only their own percentiles, kept
+        # under per_replica).
+        samples: List[float] = []
+        for r in self._fleet.local_replicas():
+            with r.engine.metrics._lock:
+                samples.extend(r.engine.metrics.ttft_ms)
+        samples.sort()
+        pct = lambda p: (samples[int(p * (len(samples) - 1))]  # noqa: E731
+                         if samples else None)
+        out["ttft_p50_ms"] = pct(0.5)
+        out["ttft_p95_ms"] = pct(0.95)
+        out.update(self._fleet.router.snapshot())
+        out["per_replica"] = per_replica
+        return out
+
+
+class EngineFleet:
+    """N engine replicas + the prefix-locality router, presented to the
+    OpenAI server as ONE engine-shaped object."""
+
+    def __init__(self, replicas: List, tokenizer, page_size: int,
+                 router_policy: str = "prefix",
+                 affinity_ttl_s: float = 300.0,
+                 load_penalty_tokens: int = 256,
+                 shadow_capacity_pages: int = 4096,
+                 health_interval_s: float = 0.0):
+        if not replicas:
+            raise ValueError("EngineFleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.tokenizer = tokenizer
+        self.router = PrefixLocalityRouter(
+            page_size, policy=router_policy, affinity_ttl_s=affinity_ttl_s,
+            load_penalty_tokens=load_penalty_tokens,
+            shadow_capacity_pages=shadow_capacity_pages)
+        self.metrics = FleetMetrics(self)
+        self._by_rid = {r.rid: r for r in self.replicas}
+        if len(self._by_rid) != len(self.replicas):
+            raise ValueError("duplicate replica ids")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # rid -> {id(req): _ReqRecord} live requests per replica.
+        self._records: Dict[str, Dict[int, _ReqRecord]] = {
+            r.rid: {} for r in self.replicas}
+        self._health_interval_s = health_interval_s
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_stop = threading.Event()
+        self._probe_errors = 0
+        for r in self.replicas:
+            self.router.add_replica(
+                r.rid, self_feed=not getattr(r, "has_prefix_cache", False))
+            r.set_reporter(self.router.reporter_for(r.rid))
+
+    # -- engine-shaped surface (what OpenAIServer consumes) ----------------
+
+    @property
+    def ecfg(self):
+        for r in self.local_replicas():
+            return r.engine.ecfg
+        return None
+
+    @property
+    def prefix_cache(self):
+        engines = [r.engine for r in self.local_replicas()
+                   if r.has_prefix_cache]
+        return _FleetPrefixCacheView(engines) if engines else None
+
+    def local_replicas(self) -> List[LocalReplica]:
+        return [r for r in self.replicas if isinstance(r, LocalReplica)]
+
+    def submit(self, req):  # graftlint: hot-path
+        """Place and dispatch one request. Raises FleetUnavailableError
+        when no replica admits; replica submit errors (e.g.
+        PromptTooLongError) propagate after the tracking is unwound."""
+        try:
+            rid = self.router.place(req.prompt_ids,
+                                    getattr(req, "session_id", ""))
+        except LookupError as e:
+            raise FleetUnavailableError(str(e)) from e
+        rec = _ReqRecord(req, rid)
+        req.stream = _TrackedStream(self, rec)
+        with self._lock:
+            self._records[rid][id(req)] = rec
+        self.router.note_submitted(rid, rec.est)
+        try:
+            self._by_rid[rid].submit(req)
+        except Exception:
+            with self._lock:
+                self._records[rid].pop(id(req), None)
+            self.router.note_finished(rid, rec.est)
+            raise
+        with self._lock:
+            rec.submitted = True
+            # Eviction raced this submit: evict() saw an unsubmitted
+            # record and left it in place for us (its takeover set only
+            # contains submitted records, so exactly one side handles
+            # it). The engine we just submitted to is stopped/stopping
+            # — move the request to a survivor.
+            raced_evict = (self._by_rid[rid].state == "evicted"
+                           and self._records[rid].pop(id(req), None)
+                           is not None)
+        if raced_evict and not rec.done:
+            replica = self._by_rid[rid]
+            try:
+                # Idempotent: joins the already-stopping engine threads
+                # so it can no longer emit into the stream we re-place.
+                replica.stop()
+            except Exception as e:
+                _LOG.warning("raced-evict stop of %s failed: %s", rid, e)
+            # This submit's deque entry must not survive into a
+            # restore() of the evicted replica.
+            self._purge(replica)
+            # Same guards as evict(): a stream with delivered tokens
+            # (the engine emitted before the stop joined) or an
+            # un-joinable source must terminate, not replay.
+            if rec.started or not getattr(replica, "supports_requeue",
+                                          True):
+                if not rec.done:
+                    req.cancelled = True
+                    req.stream.put(_error_event())
+            else:
+                self._requeue(rec)
+        return req
+
+    def start(self) -> "EngineFleet":
+        for r in self.replicas:
+            r.start()
+        if self._health_interval_s > 0:
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, daemon=True, name="fleet-probe")
+            self._probe_thread.start()
+        return self
+
+    def warmup(self, **kw) -> "EngineFleet":
+        for r in self.replicas:
+            r.warmup(**kw)
+        return self
+
+    def stop(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10)
+            self._probe_thread = None
+        for r in self.replicas:
+            r.stop()
+
+    # -- stream hook (engine scheduler/pacer threads) ----------------------
+
+    def _on_event(self, rec: _ReqRecord, ev: Dict[str, Any]) -> None:
+        rec.started = True
+        if ev.get("token_id", -1) >= 0:
+            rec.emitted += 1
+            self.router.note_progress(rec.rid, 1)
+        if ev.get("finished") and not rec.done:
+            rec.done = True
+            self.router.note_finished(rec.rid,
+                                      max(0, rec.est - rec.emitted))
+            with self._cond:
+                self._records.get(rec.rid, {}).pop(id(rec.req), None)
+                self._cond.notify_all()
+
+    # -- fleet operations --------------------------------------------------
+
+    def drain(self, rid: str, timeout_s: float = 60.0) -> bool:
+        """Graceful drain: stop admitting, let in-flight streams finish,
+        drop the shadow tree (rebalance). The engine keeps running —
+        restore(rid) re-admits it (restart story: drain, restart the
+        process/engine, restore). Returns True when the replica emptied
+        within the timeout."""
+        replica = self._by_rid[rid]
+        with self._lock:
+            replica.state = "draining"
+        self.router.set_admitting(rid, False)
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._records[rid]:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+            emptied = not self._records[rid]
+            replica.state = "drained" if emptied else "draining"
+        self.router.drop_shadow(rid)
+        return emptied
+
+    def restore(self, rid: str) -> None:
+        """Re-admit a drained/evicted replica (its cache starts cold —
+        the shadow was dropped at drain/evict time)."""
+        replica = self._by_rid[rid]
+        replica.start()
+        with self._lock:
+            replica.state = "active"
+        self.router.set_admitting(rid, True)
+
+    def evict(self, rid: str) -> int:
+        """Remove a failed replica from placement: requeue its
+        not-yet-started requests onto the survivors, terminate its
+        mid-stream requests with an error event (their KV died with
+        the replica; replaying a half-delivered stream would duplicate
+        output). Returns the number of requests requeued."""
+        replica = self._by_rid[rid]
+        self.router.set_admitting(rid, False)
+        with self._lock:
+            replica.state = "evicted"
+            recs = self._records[rid]
+            takeover = [r for r in recs.values() if r.submitted]
+            # Records whose submit() is still in flight stay behind:
+            # that submit observes the evicted state under this lock
+            # and rescues its own request (exactly one side handles
+            # each record).
+            self._records[rid] = {id(r.req): r for r in recs.values()
+                                  if not r.submitted}
+        self.router.note_evicted(rid)
+        self.router.drop_shadow(rid)
+        # Stop the dead engine BEFORE touching its requests' streams:
+        # once its scheduler/reader threads are joined, nothing can
+        # emit into a stream that is about to be re-placed (a requeue
+        # racing a half-alive scheduler would duplicate output).
+        try:
+            replica.stop()
+        except Exception as e:
+            _LOG.warning("evicted replica %s stop failed: %s", rid, e)
+        self._purge(replica)
+        requeued = 0
+        can_requeue = getattr(replica, "supports_requeue", True)
+        for rec in takeover:
+            if rec.done:
+                continue
+            if rec.started or not can_requeue:
+                # Tokens already delivered (replay would duplicate
+                # output), or the replica type can't guarantee its
+                # stream source is dead (HttpReplica zombie proxy).
+                # cancelled also pins any slot still parked on the
+                # stopped engine: a later restore() finishes it
+                # instantly instead of resuming a terminated stream.
+                # (Requeued requests must NOT be cancelled — the
+                # survivor serves them; purge_waiting above already
+                # removed their deque entries.)
+                rec.req.cancelled = True
+                rec.req.stream.put(_error_event())
+                continue
+            if self._requeue(rec):
+                requeued += 1
+        return requeued
+
+    @staticmethod
+    def _purge(replica) -> None:
+        """Drop a stopped replica's queued requests so restore() can't
+        replay them (local replicas only; remote processes own their
+        own queues)."""
+        purge = getattr(replica, "purge_waiting", None)
+        if purge is not None:
+            try:
+                purge()
+            except Exception as e:
+                _LOG.warning("purge of %s failed: %s", replica.rid, e)
+
+    def _requeue(self, rec: _ReqRecord) -> bool:
+        """Re-place one untouched request from an evicted replica. Its
+        tracked stream is kept — no events were delivered."""
+        self.router.note_finished(rec.rid, rec.est)
+        try:
+            rid = self.router.place(rec.req.prompt_ids,
+                                    getattr(rec.req, "session_id", ""))
+        except LookupError:
+            # The old rid's accounting was settled above; mark the
+            # record done BEFORE the terminal event so _on_event
+            # doesn't note_finished a second time.
+            rec.done = True
+            rec.req.stream.put(_error_event())
+            return False
+        rec.rid = rid
+        with self._lock:
+            self._records[rid][id(rec.req)] = rec
+        self.router.note_submitted(rid, rec.est)
+        try:
+            self._by_rid[rid].submit(rec.req)
+        except Exception as e:
+            _LOG.warning("requeue to %s failed: %s", rid, e)
+            with self._lock:
+                self._records[rid].pop(id(rec.req), None)
+            self.router.note_finished(rid, rec.est)
+            rec.done = True  # settled here; _on_event must not repeat it
+            rec.req.stream.put(_error_event())
+            return False
+        self.router.note_requeued()
+        return True
+
+    def check_health(self) -> Dict[str, bool]:
+        """Probe every non-evicted replica; evict the dead. Returns
+        rid -> healthy."""
+        out = {}
+        for r in self.replicas:
+            if r.state == "evicted":
+                out[r.rid] = False
+                continue
+            ok = r.healthy()
+            out[r.rid] = ok
+            if not ok:
+                _LOG.warning("fleet replica %s failed health probe; "
+                             "evicting", r.rid)
+                self.evict(r.rid)
+        return out
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.wait(self._health_interval_s):
+            try:
+                self.check_health()
+            except Exception:
+                # Counted and logged, never silent (GL302): a sick
+                # probe loop must show up in /health, not vanish.
+                _LOG.exception("fleet health probe failed")
+                with self._lock:
+                    self._probe_errors += 1
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """/health "fleet" section: replica states + drain flags."""
+        depths = self.router.queue_depths()
+        with self._lock:
+            replicas = {
+                r.rid: {
+                    "state": r.state,
+                    "draining": r.state == "draining",
+                    "queue_depth": depths.get(r.rid, 0),
+                } for r in self.replicas}
+            probe_errors = self._probe_errors
+        return {"enabled": True, "replicas": replicas,
+                "router_policy": self.router.policy,
+                "probe_errors": probe_errors}
+
+
+def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None):
+    """Wire an EngineFleet from the [fleet] config section.
+
+    `engines`: local LLMEngines (emulated/multi-chip fleet). With
+    `cfg.fleet.replica_urls` set instead, the fleet fronts remote
+    engine-server processes and `tokenizer` must be provided."""
+    fcfg = cfg.fleet
+    replicas: List = []
+    if engines:
+        tokenizer = tokenizer or engines[0].tokenizer
+        replicas += [LocalReplica(f"r{i}", e) for i, e in enumerate(engines)]
+    for i, url in enumerate(u for u in
+                            (fcfg.replica_urls or "").split(",") if u.strip()):
+        replicas.append(HttpReplica(f"h{i}", url.strip()))
+    if tokenizer is None:
+        raise ValueError("remote-only fleet needs an explicit tokenizer")
+    page_size = engines[0].ecfg.page_size if engines else \
+        cfg.engine.page_size
+    return EngineFleet(
+        replicas, tokenizer, page_size,
+        router_policy=fcfg.router_policy,
+        affinity_ttl_s=fcfg.affinity_ttl_s,
+        load_penalty_tokens=fcfg.load_penalty_tokens,
+        shadow_capacity_pages=fcfg.shadow_capacity_pages,
+        health_interval_s=fcfg.health_interval_s)

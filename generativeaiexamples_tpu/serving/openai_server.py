@@ -129,6 +129,9 @@ class OpenAIServer:
             top_p=float(body.get("top_p") or 1.0),
             top_k=int(body.get("top_k") or 0),
             request_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+            # Fleet session affinity: the OpenAI `user` field is the
+            # natural session key; a single engine ignores it.
+            session_id=str(body.get("user") or ""),
         )
 
     async def _events(self, req):
@@ -183,6 +186,13 @@ class OpenAIServer:
                 "fused_prefill_tokens": m.fused_prefill_tokens,
                 "prefill_stall_beats": m.prefill_stall_beats,
             }
+        # Always present, like the fused section: a fleet (serving/
+        # fleet.py as the llm object) reports replica states + drain
+        # flags; a single engine reports enabled=false so the key never
+        # flickers with deployment topology.
+        fleet_health = getattr(self.llm, "fleet_health", None)
+        payload["fleet"] = (fleet_health() if callable(fleet_health)
+                            else {"enabled": False, "replicas": {}})
         return web.json_response(payload)
 
     async def handle_models(self, request: web.Request) -> web.Response:
@@ -194,7 +204,13 @@ class OpenAIServer:
         return web.json_response({"object": "list", "data": models})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        snap = self.llm.metrics.snapshot() if self.llm else {}
+        # In the executor: a fleet snapshot may fetch remote replicas'
+        # /metrics over HTTP — blocking the event loop for that would
+        # stall every live SSE stream for the duration of a scrape.
+        loop = asyncio.get_running_loop()
+        snap = await loop.run_in_executor(
+            self._executor,
+            lambda: self.llm.metrics.snapshot() if self.llm else {})
         return web.json_response(snap)
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
@@ -208,12 +224,24 @@ class OpenAIServer:
             return web.json_response({"error": "no LLM engine"}, status=503)
         body = await request.json()
         req = self._gen_request(body, chat)
+        if not req.session_id:
+            req.session_id = request.headers.get("x-session-id", "")
         stops = self._stop_strings(body)
         stream = bool(body.get("stream"))
         from generativeaiexamples_tpu.serving.engine import PromptTooLongError
+        from generativeaiexamples_tpu.serving.fleet import (
+            FleetUnavailableError)
 
         try:
             self.llm.submit(req)
+        except FleetUnavailableError as e:
+            # Every replica is draining/evicted — a server-side
+            # condition (retryable), not a bad request.
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "service_unavailable",
+                           "code": "no_replica_available"}},
+                status=503)
         except PromptTooLongError as e:
             # OpenAI-style context-length rejection at the API boundary
             # (no silent truncation; reference rejects at server.py:63,85).

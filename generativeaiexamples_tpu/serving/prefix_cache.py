@@ -9,7 +9,24 @@ prefix caching (and the NIM/TRT-LLM KV-reuse feature, SURVEY.md §2.3):
 a HOST-side radix tree keyed on page-size token-id chunks maps prompt
 prefixes to ref-counted pages in the existing device PagePool.
 
-Design:
+Two consumers share the machinery (the split is this module's layering):
+
+- `RadixTree` — the payload-generic core: one node per FULL page of
+  token ids, longest-prefix match, dedup insert, LRU leaf eviction
+  under a capacity budget. Knows nothing about device pages.
+- `RadixPrefixCache(RadixTree)` — binds the core to the PageAllocator:
+  node payloads are pool page ids, the tree holds one reference per
+  cached page, and a leaf is evictable only while no live sequence
+  reads its page (refcount == 1).
+
+The fleet router (serving/router.py) builds its per-replica SHADOW
+trees on the same core: same chunking, same match semantics, no pages —
+so the router's locality score is exactly the prefix the replica's real
+cache would serve. `RadixPrefixCache` reports admissions and evictions
+through an optional `reporter` callback (token-id paths, not pages) to
+keep those shadows consistent.
+
+Design (cache-specific):
 
 - One tree node per FULL page: the edge key is the tuple of page_size
   token ids, the node owns one pool page id holding those tokens' KV
@@ -39,7 +56,7 @@ become reusable. See docs/prefix_cache.md.
 from __future__ import annotations
 
 import heapq
-from typing import List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 from generativeaiexamples_tpu.serving.kv_cache import PageAllocator
 
@@ -47,24 +64,30 @@ from generativeaiexamples_tpu.serving.kv_cache import PageAllocator
 class _Node:
     __slots__ = ("key", "page", "children", "parent", "last_used")
 
-    def __init__(self, key, page: int, parent):
+    def __init__(self, key, page, parent):
         self.key = key          # tuple of page_size token ids (root: None)
-        self.page = page        # pool page id (root: 0, the sink)
+        self.page = page        # payload: pool page id (shadow trees: None)
         self.parent = parent
         self.children: dict = {}
         self.last_used = 0
 
 
-class RadixPrefixCache:
-    """Page-granular radix tree over prompt token ids -> pool pages."""
+class RadixTree:
+    """Payload-generic radix tree over page-size token-id chunks.
 
-    def __init__(self, allocator: PageAllocator, page_size: int,
-                 capacity_pages: int):
-        self.allocator = allocator
+    Subclasses bind the payload semantics through three hooks:
+    `_adopt(payload)` when a new node takes one, `_release(node)` when
+    a node is evicted, and `_evictable(node)` gating LRU eviction.
+    The base class is fully functional with `None` payloads (the
+    router's shadow trees use it exactly so).
+    """
+
+    def __init__(self, page_size: int, capacity_pages: int):
         self.page_size = page_size
         # Budget for pages the tree holds (referenced or not); trim()
-        # LRU-evicts down to it after inserts. Allocator pressure can
-        # shrink the resident set further at any time.
+        # LRU-evicts down to it after inserts. External pressure (the
+        # allocator's reclaim hook) can shrink the resident set further
+        # at any time.
         self.capacity_pages = max(0, int(capacity_pages))
         self.root = _Node(None, 0, None)
         self._clock = 0   # monotonic LRU clock (no wall time needed)
@@ -74,6 +97,28 @@ class RadixPrefixCache:
     @property
     def n_cached_pages(self) -> int:
         return self._n_pages
+
+    # -- payload hooks (subclasses override) -------------------------------
+
+    def _adopt(self, payload) -> None:
+        """A new node is about to take `payload` (cache: retain page)."""
+
+    def _release(self, node: _Node) -> None:
+        """`node` was evicted (cache: release its page)."""
+
+    def _evictable(self, node: _Node) -> bool:
+        """May evict() free this leaf right now? (cache: refcount==1)."""
+        return True
+
+    def _reporting(self) -> bool:
+        """Is anyone listening? Report ARGUMENTS (token-id tuples,
+        root-walk paths) are only built when this is True, so the
+        reporter-less scheduler hot path pays nothing."""
+        return False
+
+    def _report(self, kind: str, ids: tuple) -> None:
+        """Eviction/insert event hook (cache: feeds the fleet router's
+        shadow trees). Base tree: no-op."""
 
     # -- internals ---------------------------------------------------------
 
@@ -95,48 +140,60 @@ class RadixPrefixCache:
             else:
                 yield n
 
-    # -- public API (scheduler thread only) --------------------------------
+    def _path_ids(self, node: _Node) -> tuple:
+        """Token ids spelling the path root -> node (the prefix whose
+        last page this node caches)."""
+        keys = []
+        while node is not self.root:
+            keys.append(node.key)
+            node = node.parent
+        return tuple(t for key in reversed(keys) for t in key)
 
-    def match(self, ids: Sequence[int]) -> List[int]:
-        """Longest cached page-granular prefix of `ids` -> page list
-        (pages[i] holds tokens ids[i*ps:(i+1)*ps]). Touches the whole
+    # -- public API (owner thread only) ------------------------------------
+
+    def match_nodes(self, ids: Sequence[int]) -> List[_Node]:
+        """Longest cached page-granular prefix of `ids` -> node list
+        (node i holds tokens ids[i*ps:(i+1)*ps]). Touches the whole
         matched path so hot prefixes stay resident."""
-        node, pages = self.root, []
+        node, out = self.root, []
         for chunk in self._chunks(ids):
             child = node.children.get(chunk)
             if child is None:
                 break
             self._touch(child)
-            pages.append(child.page)
+            out.append(child)
             node = child
-        return pages
+        return out
 
-    def insert(self, ids: Sequence[int], pages: Sequence[int]) -> int:
-        """Register a completed prefill: chunk i of `ids` maps to
-        pages[i] (the sequence's pages; the tree retains its OWN
-        reference on adoption). Chunks already present keep their
-        existing page — dedup: the duplicate stays private to the
-        inserting sequence and is freed at its release. Returns the
-        number of pages newly adopted."""
-        node, new = self.root, 0
+    def insert(self, ids: Sequence[int],
+               pages: Optional[Sequence] = None) -> int:
+        """Register chunk i of `ids` -> pages[i] (payload; None for
+        payload-less trees). Chunks already present keep their existing
+        node — dedup: the duplicate payload stays with the caller.
+        Returns the number of nodes newly created."""
+        node, new, walked = self.root, 0, 0
         for i, chunk in enumerate(self._chunks(ids)):
-            if i >= len(pages):
+            if pages is not None and i >= len(pages):
                 break
             child = node.children.get(chunk)
             if child is None:
-                self.allocator.retain([pages[i]])
-                child = _Node(chunk, pages[i], node)
+                payload = pages[i] if pages is not None else None
+                self._adopt(payload)
+                child = _Node(chunk, payload, node)
                 node.children[chunk] = child
                 self._n_pages += 1
                 new += 1
             self._touch(child)
             node = child
+            walked = i + 1
+        if walked and self._reporting():
+            self._report("insert", tuple(ids[: walked * self.page_size]))
         return new
 
     def evict(self, n_pages: int) -> int:
-        """Free up to n_pages LRU leaf pages that only the tree
-        references, releasing them back to the allocator. Returns the
-        count actually freed (live-referenced chains are skipped)."""
+        """Free up to n_pages LRU leaf pages that pass `_evictable`,
+        releasing their payloads. Returns the count actually freed
+        (live-referenced chains are skipped)."""
         freed = 0
         heap = [(n.last_used, id(n), n) for n in self._leaves()]
         heapq.heapify(heap)
@@ -144,10 +201,12 @@ class RadixPrefixCache:
             _, _, node = heapq.heappop(heap)
             if node.children:
                 continue  # gained a child since collection; not a leaf
-            if self.allocator.refcount(node.page) != 1:
-                continue  # a live sequence still reads it
+            if not self._evictable(node):
+                continue
+            if self._reporting():
+                self._report("evict", self._path_ids(node))
             del node.parent.children[node.key]
-            self.allocator.release([node.page])
+            self._release(node)
             self._n_pages -= 1
             freed += 1
             parent = node.parent
@@ -160,6 +219,46 @@ class RadixPrefixCache:
         """LRU-evict down to the capacity budget; returns pages freed."""
         over = self._n_pages - self.capacity_pages
         return self.evict(over) if over > 0 else 0
+
+
+class RadixPrefixCache(RadixTree):
+    """Page-granular radix tree over prompt token ids -> pool pages."""
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 capacity_pages: int):
+        super().__init__(page_size, capacity_pages)
+        self.allocator = allocator
+        self.root.page = 0  # the sink page
+        # Admission/eviction report hook for the fleet router's shadow
+        # trees (serving/router.py): called on the scheduler thread with
+        # ("insert"|"evict", token_id_tuple) — must be cheap and never
+        # raise. None (the default, single-engine mode) is free.
+        self.reporter: Optional[Callable[[str, tuple], None]] = None
+
+    # -- payload hooks ------------------------------------------------------
+
+    def _adopt(self, payload) -> None:
+        self.allocator.retain([payload])
+
+    def _release(self, node: _Node) -> None:
+        self.allocator.release([node.page])
+
+    def _evictable(self, node: _Node) -> bool:
+        # refcount > 1: a live sequence still reads this page.
+        return self.allocator.refcount(node.page) == 1
+
+    def _reporting(self) -> bool:
+        return self.reporter is not None
+
+    def _report(self, kind: str, ids: tuple) -> None:
+        self.reporter(kind, ids)
+
+    # -- public API (scheduler thread only) --------------------------------
+
+    def match(self, ids: Sequence[int]) -> List[int]:
+        """Longest cached page-granular prefix of `ids` -> page list
+        (pages[i] holds tokens ids[i*ps:(i+1)*ps])."""
+        return [n.page for n in self.match_nodes(ids)]
 
     def reclaimable(self) -> int:
         """Pages evict() could free RIGHT NOW: maximal pendant subtrees

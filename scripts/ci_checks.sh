@@ -18,7 +18,11 @@
 #                   path (scripts/smoke_plan_step.py: riders carry the
 #                   whole prompt, tree drafts > 1 token/verify-step,
 #                   byte-equality vs offline greedy).
-#   5. tier-1 tests — the ROADMAP.md pytest gate.
+#   5. router smoke — CPU gate for the 2-replica fleet
+#                   (scripts/smoke_router.py: routed streams byte-
+#                   identical to a single engine, prefix hit on turn 2,
+#                   graceful drain finishes the in-flight stream).
+#   6. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -42,6 +46,9 @@ python scripts/gen_config_docs.py --check || fail=1
 if [ "${1:-}" != "--fast" ]; then
     step "step-plan smoke (JAX_PLATFORMS=cpu scripts/smoke_plan_step.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_plan_step.py || fail=1
+
+    step "router smoke (JAX_PLATFORMS=cpu scripts/smoke_router.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_router.py || fail=1
 
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

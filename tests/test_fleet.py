@@ -1,0 +1,491 @@
+"""Serving fleet: prefix-locality router over data-parallel replicas.
+
+Covers the placement policy units (locality beats load-only on a
+replayed conversation, session affinity, stable-hash fallback), shadow
+-tree consistency under real cache eviction, health-eviction with
+requeue, graceful drain, the always-present counter surface, and the
+N-thread end-to-end gate: a 2-replica fleet's streams are
+byte-identical to a single engine's.
+"""
+
+import os
+import queue
+import textwrap
+import threading
+import time
+
+import jax
+import pytest
+
+from generativeaiexamples_tpu.config.schema import EngineConfig
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.serving.engine import GenRequest, LLMEngine
+from generativeaiexamples_tpu.serving.fleet import (
+    EngineFleet, FleetUnavailableError, LocalReplica, sse_json_events)
+from generativeaiexamples_tpu.serving.kv_cache import PageAllocator
+from generativeaiexamples_tpu.serving.prefix_cache import RadixPrefixCache
+from generativeaiexamples_tpu.serving.router import (
+    PrefixLocalityRouter, ShadowRadixTree)
+from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+TINY = llama.LlamaConfig.tiny()
+PS = 8  # page size used throughout
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(TINY, jax.random.PRNGKey(0))
+
+
+def make_engine(params, **over):
+    cfg = dict(max_batch_size=2, max_seq_len=256, page_size=PS,
+               prefill_buckets=(16, 32), prefix_cache=True,
+               pace_emission_max_streams=0, compile_cache_dir="")
+    cfg.update(over)
+    return LLMEngine(params, TINY, ByteTokenizer(), EngineConfig(**cfg),
+                     use_pallas=False)
+
+
+def make_fleet(params, n=2, **fleet_kw):
+    engines = [make_engine(params) for _ in range(n)]
+    reps = [LocalReplica(f"r{i}", e) for i, e in enumerate(engines)]
+    fleet = EngineFleet(reps, ByteTokenizer(), PS, **fleet_kw).start()
+    return fleet, engines
+
+
+def collect(req, timeout=120):
+    toks = []
+    while True:
+        ev = req.stream.get(timeout=timeout)
+        if ev["token_id"] >= 0:
+            toks.append(ev["token_id"])
+        if ev["finished"]:
+            return toks, ev["finish_reason"]
+
+
+def run_one(target, prompt, session="", max_new=16):
+    req = GenRequest(prompt_ids=list(prompt), max_new_tokens=max_new,
+                     session_id=session)
+    target.submit(req)
+    return collect(req)[0]
+
+
+# ---------------------------------------------------------------------------
+# router policy units (no engines)
+# ---------------------------------------------------------------------------
+
+class TestPlacementPolicy:
+    def _router(self, policy="prefix", **kw):
+        r = PrefixLocalityRouter(PS, policy=policy, **kw)
+        r.add_replica("r0", self_feed=False)
+        r.add_replica("r1", self_feed=False)
+        return r
+
+    def test_locality_beats_load_only_on_replayed_conversation(self):
+        """Turn 2 of a conversation goes back to the replica holding
+        its prefix KV even though it is the DEEPER queue; a load-only
+        policy sends it to the shallow one and re-prefills from zero."""
+        turn1 = list(range(40))
+        turn2 = turn1 + [99] * 24
+        for policy, expect in (("prefix", "r0"), ("least_load", "r1")):
+            r = self._router(policy, load_penalty_tokens=8)
+            # Replica r0 cached turn 1 (admission report), then got busy.
+            r.reporter_for("r0")("insert", tuple(turn1))
+            for _ in range(3):
+                r.note_submitted("r0", 16)
+            assert r.place(turn2) == expect, policy
+        r = self._router("prefix", load_penalty_tokens=8)
+        r.reporter_for("r0")("insert", tuple(turn1))
+        for _ in range(3):
+            r.note_submitted("r0", 16)
+        r.place(turn2)
+        snap = r.snapshot()
+        assert snap["router_prefix_hits"] == 1
+        # 40 prompt tokens = 5 full pages of locality credited.
+        assert snap["router_hit_tokens"] == 40
+
+    def test_locality_yields_when_owner_is_drowning(self):
+        """A cached prefix stops winning once its replica is deeper
+        than the skipped prefill is worth."""
+        r = self._router("prefix", load_penalty_tokens=16)
+        turn1 = list(range(16))
+        r.reporter_for("r0")("insert", tuple(turn1))
+        for _ in range(8):  # 8 * 16 penalty >> 16 matched tokens
+            r.note_submitted("r0", 16)
+        assert r.place(turn1 + [5] * 8) == "r1"
+
+    def test_session_affinity_and_ttl(self):
+        r = self._router("prefix", affinity_ttl_s=30.0)
+        first = r.place([1, 2, 3] * 10, session="alice")
+        # A completely different prompt sticks to the session's replica.
+        assert r.place([9] * 30, session="alice") == first
+        assert r.snapshot()["router_affinity_hits"] == 1
+        r2 = self._router("prefix", affinity_ttl_s=0.0)
+        r2.place([1, 2, 3] * 10, session="bob")  # expires immediately
+        # No affinity hit on the second placement (TTL elapsed).
+        r2.place([1, 2, 3] * 10, session="bob")
+        assert r2.snapshot()["router_affinity_hits"] == 0
+
+    def test_stable_hash_fallback_converges_and_respects_overload(self):
+        r = self._router("prefix")
+        cold = [42] * 24
+        rids = {r.place(cold) for _ in range(4)}
+        assert len(rids) == 1  # identical cold template -> one replica
+        (rid,) = rids
+        # Drown the hash choice: fallback overrides to least-loaded.
+        for _ in range(8):
+            r.note_submitted(rid, 16)
+        assert r.place(cold) != rid
+
+    def test_no_admitting_replica_raises(self):
+        r = self._router()
+        r.set_admitting("r0", False)
+        r.set_admitting("r1", False)
+        with pytest.raises(LookupError):
+            r.place([1, 2, 3])
+
+    def test_round_robin_rotates(self):
+        r = self._router("round_robin")
+        seen = [r.place([1] * 8) for _ in range(4)]
+        assert seen[0] != seen[1] and seen[0] == seen[2]
+
+
+# ---------------------------------------------------------------------------
+# shadow-tree consistency
+# ---------------------------------------------------------------------------
+
+class TestShadowConsistency:
+    def test_shadow_mirrors_cache_insert_and_eviction(self):
+        """Wire a real RadixPrefixCache's reporter into a shadow tree:
+        after inserts AND LRU evictions the shadow scores exactly what
+        the cache still holds."""
+        alloc = PageAllocator(64)
+        cache = RadixPrefixCache(alloc, PS, capacity_pages=64)
+        shadow = ShadowRadixTree(PS, 4096)
+
+        def apply(kind, ids):
+            if kind == "insert":
+                shadow.insert(ids)
+            else:
+                shadow.remove_path(ids)
+
+        cache.reporter = apply
+        a = list(range(32))            # 4 pages
+        b = list(range(16)) + [7] * 16  # shares 2 pages with a
+        pa = alloc.alloc(4)
+        cache.insert(a, pa)
+        pb = alloc.alloc(4)
+        cache.insert(b, pb)
+        assert shadow.match_tokens(a) == 32
+        assert shadow.match_tokens(b) == 32
+        # Free the sequences' own references so leaves become evictable,
+        # then evict everything the cache holds.
+        alloc.release(pa)
+        alloc.release(pb[2:])  # pb[:2] were dedup'd duplicates
+        evicted = cache.evict(64)
+        assert evicted == cache.evictions == 6
+        assert shadow.match_tokens(a) == 0
+        assert shadow.match_tokens(b) == 0
+        assert shadow.n_cached_pages == 0
+
+    def test_remove_path_prunes_deeper_self_fed_subtree(self):
+        shadow = ShadowRadixTree(PS, 4096)
+        shadow.insert(list(range(32)))
+        # Eviction report for the 3rd page: its subtree (page 4) goes too.
+        shadow.remove_path(list(range(24)))
+        assert shadow.match_tokens(list(range(32))) == 16
+
+    def test_shadow_trim_is_lru(self):
+        shadow = ShadowRadixTree(PS, 2)
+        shadow.insert([1] * 8)
+        shadow.insert([2] * 8)
+        shadow.match_tokens([1] * 8)  # touch 1 -> 2 is LRU
+        shadow.insert([3] * 8)
+        assert shadow.trim() == 1
+        assert shadow.match_tokens([2] * 8) == 0
+        assert shadow.match_tokens([1] * 8) == 8
+
+
+# ---------------------------------------------------------------------------
+# fleet lifecycle with fake replicas (no engines)
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    def __init__(self, rid):
+        self.rid = rid
+        self.state = "active"
+        self.has_prefix_cache = False
+        self.submitted = []
+        self.alive = True
+        self.stopped = False
+
+    def set_reporter(self, fn):
+        pass
+
+    def submit(self, req):
+        self.submitted.append(req)
+
+    def healthy(self):
+        return self.alive
+
+    def start(self):
+        pass
+
+    def stop(self):
+        self.stopped = True
+
+    def warmup(self, **kw):
+        pass
+
+    def metrics_snapshot(self):
+        return {}
+
+
+class TestHealthEvictionAndRequeue:
+    def _fleet(self):
+        fakes = [FakeReplica("r0"), FakeReplica("r1")]
+        return EngineFleet(fakes, ByteTokenizer(), PS).start(), fakes
+
+    def test_dead_replica_evicted_and_waiting_request_requeued(self):
+        fleet, fakes = self._fleet()
+        req = GenRequest(prompt_ids=[3] * 24, max_new_tokens=8)
+        fleet.submit(req)
+        victim = next(f for f in fakes if f.submitted)
+        other = next(f for f in fakes if not f.submitted)
+        victim.alive = False
+        health = fleet.check_health()
+        assert health[victim.rid] is False and health[other.rid] is True
+        assert victim.state == "evicted" and victim.stopped
+        # The untouched request moved to the survivor, same stream.
+        assert other.submitted == [req]
+        snap = fleet.metrics.snapshot()
+        assert snap["replica_evictions"] == 1
+        assert snap["router_requeued"] == 1
+        assert snap["router_rebalances"] == 1
+        assert fleet.fleet_health()["replicas"][victim.rid]["state"] == \
+            "evicted"
+        # Evicted replicas never admit again until restore().
+        for _ in range(4):
+            r = GenRequest(prompt_ids=[4] * 24, max_new_tokens=8)
+            fleet.submit(r)
+            assert r in other.submitted
+
+    def test_midstream_request_terminated_not_replayed(self):
+        fleet, fakes = self._fleet()
+        req = GenRequest(prompt_ids=[5] * 24, max_new_tokens=8)
+        fleet.submit(req)
+        victim = next(f for f in fakes if f.submitted)
+        other = next(f for f in fakes if not f.submitted)
+        # Replica delivered one token before dying: replaying would
+        # duplicate output, so the stream ends with an error event.
+        req.stream.put({"text": "x", "token_id": 7, "finished": False,
+                        "finish_reason": None})
+        victim.alive = False
+        fleet.check_health()
+        assert req not in other.submitted
+        toks, reason = collect(req, timeout=5)
+        assert toks == [7] and reason == "error"
+
+    def test_all_replicas_down_is_unavailable(self):
+        fleet, fakes = self._fleet()
+        for f in fakes:
+            f.alive = False
+        fleet.check_health()
+        with pytest.raises(FleetUnavailableError):
+            fleet.submit(GenRequest(prompt_ids=[1] * 8))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over real engines (CPU, tiny model)
+# ---------------------------------------------------------------------------
+
+class TestFleetE2E:
+    def test_nthread_streams_byte_identical_to_single_engine(self, params):
+        """The fleet acceptance gate: N threads of greedy traffic
+        through 2 replicas produce exactly the single-engine streams,
+        and a replayed conversation turn scores a router prefix hit."""
+        single = make_engine(params).start()
+        prompts = [[(7 * i + j) % 250 + 1 for j in range(20 + 2 * i)]
+                   for i in range(6)]
+        want = [run_one(single, p) for p in prompts]
+        single.stop()
+
+        fleet, engines = make_fleet(params)
+        try:
+            got = [None] * len(prompts)
+            errs = []
+
+            def worker(i):
+                try:
+                    got[i] = run_one(fleet, prompts[i])
+                except Exception as e:  # surfaced below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errs
+            assert got == want
+            snap = fleet.metrics.snapshot()
+            assert snap["router_requests"] == len(prompts)
+            assert set(snap["router_queue_depth"]) == {"r0", "r1"}
+            assert all(v == 0 for v in snap["router_queue_depth"].values())
+        finally:
+            fleet.stop()
+
+    def test_conversation_replay_hits_same_replica(self, params):
+        fleet, engines = make_fleet(params)
+        try:
+            turn1 = [11] * 40
+            out1 = run_one(fleet, turn1, session="s1")
+            turn2 = turn1 + out1 + [13] * 8
+            run_one(fleet, turn2, session="s1")
+            snap = fleet.metrics.snapshot()
+            assert snap["router_prefix_hits"] >= 1
+            assert snap["router_hit_tokens"] >= 40
+            # The ENGINE-level cache hit proves the router sent turn 2
+            # to the replica that really holds the KV pages.
+            assert sum(e.metrics.prefix_hits for e in engines) == 1
+            assert snap["prefix_hits"] == 1  # aggregated surface
+        finally:
+            fleet.stop()
+
+    def test_restore_after_evict_restarts_local_engine(self, params):
+        """Evicting a dead local replica stops its engine; restore()
+        must actually RESTART the scheduler (the stop leaves the joined
+        thread object behind), or re-admitted traffic would queue on a
+        parked engine forever."""
+        fleet, engines = make_fleet(params)
+        try:
+            engines[0].stop()  # dies out from under the fleet
+            assert fleet.check_health()["r0"] is False
+            assert fleet.fleet_health()["replicas"]["r0"]["state"] == \
+                "evicted"
+            fleet.restore("r0")
+            assert engines[0]._running and engines[0]._thread.is_alive()
+            # Drain r1 so traffic MUST land on the restored replica.
+            fleet.drain("r1", timeout_s=60.0)
+            assert run_one(fleet, [3] * 16, max_new=8)
+        finally:
+            fleet.stop()
+
+    def test_evict_requeues_and_purges_dead_queue(self, params):
+        """A request parked in a dead replica's waiting deque is
+        requeued to a survivor AND purged from the dead engine, so a
+        later restore() cannot replay it into the survivor's stream."""
+        fleet, engines = make_fleet(params, router_policy="round_robin")
+        try:
+            engines[0].stop()  # r0's scheduler parks; deque accumulates
+            reqs = [GenRequest(prompt_ids=[i + 3] * 16, max_new_tokens=6)
+                    for i in range(2)]
+            for r in reqs:
+                fleet.submit(r)
+            assert len(engines[0].waiting) == 1  # round-robin -> one on r0
+            fleet.check_health()  # evicts r0, requeues its request to r1
+            assert not engines[0].waiting  # purged
+            fleet.restore("r0")
+            for r in reqs:
+                toks, reason = collect(r, timeout=120)
+                assert toks and reason != "error"
+                assert r.stream.empty()  # exactly one terminal, no replay
+        finally:
+            fleet.stop()
+
+    def test_graceful_drain_finishes_inflight_stream(self, params):
+        fleet, engines = make_fleet(params)
+        try:
+            req = GenRequest(prompt_ids=[9] * 24, max_new_tokens=64)
+            fleet.submit(req)
+            rid = next(r for r, d in
+                       fleet.router.queue_depths().items() if d)
+            done = fleet.drain(rid, timeout_s=120.0)
+            assert done
+            toks, reason = collect(req, timeout=5)
+            assert len(toks) == 64 or reason == "stop"
+            assert reason != "error"
+            assert fleet.fleet_health()["replicas"][rid]["state"] == \
+                "drained"
+            # Drained replica admits nothing; traffic flows to the other.
+            other = run_one(fleet, [8] * 16)
+            assert other  # served
+            assert fleet.router.queue_depths()[rid] == 0
+            assert fleet.metrics.snapshot()["router_rebalances"] == 1
+            # restore() re-admits it.
+            fleet.restore(rid)
+            assert fleet.fleet_health()["replicas"][rid]["state"] == \
+                "active"
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# surfaces
+# ---------------------------------------------------------------------------
+
+class TestCounterSurfaces:
+    def test_single_engine_snapshot_carries_router_zeros(self, params):
+        eng = make_engine(params)
+        snap = eng.metrics.snapshot()
+        for key in ("router_requests", "router_prefix_hits",
+                    "router_hit_tokens", "router_affinity_hits",
+                    "router_rebalances", "replica_evictions",
+                    "router_requeued"):
+            assert snap[key] == 0
+        assert snap["router_queue_depth"] == {}
+
+    def test_fleet_snapshot_shape(self):
+        fleet = EngineFleet([FakeReplica("r0"), FakeReplica("r1")],
+                            ByteTokenizer(), PS)
+        snap = fleet.metrics.snapshot()
+        assert set(snap["per_replica"]) == {"r0", "r1"}
+        assert snap["router_requests"] == 0
+        assert snap["tokens_generated"] == 0
+
+    def test_sse_event_parser(self):
+        lines = [
+            b'data: {"choices": [{"text": "he", "finish_reason": null}]}\n',
+            b"\n",
+            b": comment\n",
+            b'data: {"choices": [{"text": "y", "finish_reason": "stop"}]}\n',
+            b"data: [DONE]\n",
+            b'data: {"never": "reached"}\n',
+        ]
+        evs = list(sse_json_events(iter(lines)))
+        assert [e["choices"][0]["text"] for e in evs] == ["he", "y"]
+
+
+class TestLintCoverage:
+    def test_gl201_covers_router_replica_state_lock(self, tmp_path):
+        """GL201's lock-discipline check must treat the router's
+        replica-state lock like any engine lock: a seeded bare write of
+        a counter that place() mutates under self._lock is flagged."""
+        from generativeaiexamples_tpu.lint import lint_paths
+
+        src_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "generativeaiexamples_tpu",
+            "serving", "router.py")
+        with open(src_path) as fh:
+            src = fh.read()
+        bad = src + textwrap.dedent("""
+
+        class _SeededBadRouter(PrefixLocalityRouter):
+            # Inherits self._lock from PrefixLocalityRouter: GL201 must
+            # merge same-module base locks and flag the bare write.
+            def locked_ok(self):
+                with self._lock:
+                    self.router_requests += 1
+
+            def hack(self):
+                self.router_requests += 1  # bare write, no lock
+        """)
+        mod = tmp_path / "router.py"
+        mod.write_text(bad)
+        findings = [f for f in lint_paths([str(mod)])
+                    if f.check == "GL201"]
+        assert any("router_requests" in f.message for f in findings)
+        # ... and the shipped router itself is clean.
+        assert not [f for f in lint_paths([src_path])
+                    if f.check == "GL201"]

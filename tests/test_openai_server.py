@@ -131,6 +131,68 @@ def test_health_and_metrics_surface_fused_counters(server):
     assert m["spec_fallback_steps"] == 0
 
 
+def test_health_and_metrics_surface_fleet_counters(server):
+    """The fleet/router surface follows the same always-present
+    convention: a single-engine server reports fleet.enabled=false in
+    /health and zeroed router counters in /metrics — the keys never
+    flicker with deployment topology."""
+    async def body(c):
+        h = await (await c.get("/health")).json()
+        m = await (await c.get("/metrics")).json()
+        return h, m
+
+    h, m = _client_call(server, body)
+    assert h["fleet"] == {"enabled": False, "replicas": {}}
+    for key in ("router_requests", "router_prefix_hits",
+                "router_hit_tokens", "router_affinity_hits",
+                "router_rebalances", "replica_evictions",
+                "router_requeued"):
+        assert m[key] == 0
+    assert m["router_queue_depth"] == {}
+
+
+def test_fleet_server_streams_and_health(server):
+    """An OpenAIServer whose llm object IS a fleet: streaming works
+    through the router unchanged, /health carries replica states, and
+    the `user` field reaches the router as the session key."""
+    from generativeaiexamples_tpu.serving.fleet import (
+        EngineFleet, LocalReplica)
+
+    llm, _, _ = server
+    fleet = EngineFleet([LocalReplica("r0", llm)], llm.tokenizer,
+                        llm.ecfg.page_size)
+
+    async def body(c):
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4, "user": "sess-1"})
+        h = await (await c.get("/health")).json()
+        m = await (await c.get("/metrics")).json()
+        return r.status, await r.json(), h, m
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def runner():
+        srv = OpenAIServer(fleet, model_name="tiny-llama")
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            return await body(client)
+        finally:
+            await client.close()
+
+    status, data, h, m = asyncio.run(runner())
+    assert status == 200
+    assert data["usage"]["completion_tokens"] == 4
+    assert h["fleet"]["enabled"] is True
+    assert h["fleet"]["replicas"]["r0"]["state"] == "active"
+    assert m["router_requests"] == 1
+    assert m["router_queue_depth"] == {"r0": 0}
+    assert "r0" in m["per_replica"]
+    # The session key landed in the router's affinity map.
+    assert fleet.router._affinity.get("sess-1", (None,))[0] == "r0"
+
+
 def test_chat_completion_non_streaming(server):
     async def body(c):
         r = await c.post("/v1/chat/completions", json={
