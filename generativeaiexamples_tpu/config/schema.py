@@ -41,6 +41,27 @@ class VectorStoreConfig:
     # Store IVF rows as int8 + per-row scales (1/4 the f32 HBM
     # footprint; ~1e-2 relative score error). ivf only.
     quantize_int8: bool = False
+    # Tiered demand-paged IVF (ops/tiered.py): HBM holds centroids +
+    # the most-probed partitions' row blocks inside hbm_budget_mb, the
+    # rest of the corpus lives in a host-RAM warm cache (ram_budget_mb)
+    # over an mmap'd disk spill file, and a background pager promotes/
+    # demotes whole partitions by probe-frequency EMA. Probes that miss
+    # HBM refine on the host in the same logical search — slower, never
+    # wrong. Requires index_type=ivf; single-device (no mesh). Off by
+    # default — off is byte-identical to the PR-2 IVF path.
+    tiered: bool = False
+    # Device budget for the hot partition table (centroids excluded;
+    # floored at one partition slot).
+    hbm_budget_mb: int = 256
+    # Host-RAM budget for the warm cache of spill-file partition blocks.
+    ram_budget_mb: int = 1024
+    # Directory for the tiered index's spill file. Empty = a `tiered/`
+    # subdirectory of persist_dir, or a fresh temp directory when the
+    # store is ephemeral.
+    spill_dir: str = ""
+    # Per-search decay of the pager's probe-frequency EMA (closer to 1
+    # = longer memory, slower residency shifts).
+    pager_ema_decay: float = 0.98
     # Durable store directory ("ingested data persists across sessions",
     # reference CHANGELOG.md:63). Empty = ephemeral; deployments set it
     # (deploy/compose.env APP_VECTORSTORE_PERSISTDIR).

@@ -96,9 +96,23 @@ def get_embedder(config: AppConfig, hub: Optional[EngineHub] = None):
 
         return HashEmbedder(dim=config.embeddings.dimensions)
     if eng in ("lexical", "tfidf", "bm25"):
+        import os
+
         from generativeaiexamples_tpu.connectors.lexical import LexicalEmbedder
 
-        return LexicalEmbedder(dim=max(config.embeddings.dimensions, 1024))
+        # The configured dimension is honored as-is (a too-small dim
+        # raises a clear config error inside LexicalEmbedder) — the old
+        # silent max(dim, 1024) widening produced vectors that no
+        # longer matched a collection created at the configured dim by
+        # another engine, failing at insert instead of at config load.
+        # With a durable store, the DF/IDF state persists alongside it
+        # so a restarted (or separate query-serving) process keeps the
+        # evaluated TF-IDF weighting instead of degrading to plain TF.
+        persist = config.vector_store.persist_dir
+        return LexicalEmbedder(
+            dim=config.embeddings.dimensions,
+            persist_path=(os.path.join(persist, "lexical_df.json")
+                          if persist else None))
     if eng in ("openai", "nim", "remote") or (config.embeddings.server_url and
                                               eng != "tpu"):
         from generativeaiexamples_tpu.connectors.openai_http import (

@@ -266,7 +266,15 @@ class IngestPipeline:
     """sources -> chunk -> batched embed -> store (pipeline.py:32-102).
 
     `stats` carries the MonitorStage counters: per-stage totals and the
-    embed-stage rate.
+    embed-stage rate. The embed and store stages are PIPELINED through
+    a bounded handoff: batch n+1 embeds while batch n's `store.add`
+    runs, so a store whose add path does real work (the tiered ANN
+    index assigning rows to partitions, a durable store persisting)
+    no longer gates the encoder — the sustained-streaming shape the
+    tiered index's warm-tail ingest is built for. When the store
+    exposes `stats()` (the in-process vector stores), the final stats
+    carry a `store` snapshot so callers see corpus size and the tier
+    pager's counters alongside the stage totals.
     """
 
     def __init__(self, sources: Sequence, splitter, embedder, store, *,
@@ -286,9 +294,37 @@ class IngestPipeline:
                 await chunk_q.put((c, dict(item.metadata)))
                 self.stats["chunks"] += 1
 
+    async def _store_sink(self, batch_q: asyncio.Queue) -> None:
+        """Consume embedded batches and add them to the store. One
+        batch in flight here overlaps with the NEXT batch's embedding
+        in _embed_and_store; `None` ends the stage."""
+        while True:
+            batch = await batch_q.get()
+            if batch is None:
+                return
+            texts, metas, embs = batch
+            await asyncio.to_thread(self.store.add, texts, embs, metas)
+            self.stats["embeddings"] += len(texts)
+
     async def _embed_and_store(self, chunk_q: asyncio.Queue,
                                done: asyncio.Event) -> None:
         buf: List = []
+        batch_q: asyncio.Queue = asyncio.Queue(maxsize=2)
+        sink = asyncio.create_task(self._store_sink(batch_q))
+
+        async def put_or_die(item):
+            """Enqueue for the store stage, racing the put against the
+            sink itself: if store.add crashes while the bounded queue
+            is full, a bare put would block forever with no consumer —
+            surface the store error here instead."""
+            put = asyncio.ensure_future(batch_q.put(item))
+            await asyncio.wait({put, sink},
+                               return_when=asyncio.FIRST_COMPLETED)
+            if put.done():
+                return put.result()
+            put.cancel()
+            sink.result()  # sink finished first -> raise its error
+            raise RuntimeError("store sink exited before ingest finished")
 
         async def flush():
             if not buf:
@@ -297,19 +333,27 @@ class IngestPipeline:
             metas = [m for _, m in buf]
             embs = await asyncio.to_thread(
                 self.embedder.embed_documents, texts)
-            await asyncio.to_thread(self.store.add, texts, embs, metas)
-            self.stats["embeddings"] += len(buf)
+            await put_or_die((texts, metas, embs))
             buf.clear()
 
-        while True:
-            try:
-                buf.append(await asyncio.wait_for(chunk_q.get(), timeout=0.1))
-                if len(buf) >= self.embed_batch:
-                    await flush()
-            except asyncio.TimeoutError:
-                await flush()  # drain partial batches while idle
-                if done.is_set() and chunk_q.empty():
-                    return
+        try:
+            while True:
+                try:
+                    buf.append(await asyncio.wait_for(chunk_q.get(),
+                                                      timeout=0.1))
+                    if len(buf) >= self.embed_batch:
+                        await flush()
+                except asyncio.TimeoutError:
+                    await flush()  # drain partial batches while idle
+                    if done.is_set() and chunk_q.empty():
+                        return
+        finally:
+            if not sink.done():
+                try:
+                    await put_or_die(None)
+                except Exception:
+                    pass  # sink error re-raised by the await below
+            await sink
 
     async def run_async(self) -> Dict:
         t0 = time.perf_counter()
@@ -324,6 +368,23 @@ class IngestPipeline:
             await sink
         self.stats["elapsed_s"] = round(time.perf_counter() - t0, 3)
         rate = self.stats["embeddings"] / max(self.stats["elapsed_s"], 1e-6)
+        self.stats["embeddings_per_s"] = round(rate, 1)
+        # Embedders with throttled learned state (LexicalEmbedder's DF
+        # snapshot) force-persist what the throttle held back.
+        for target in (self.embedder, getattr(self.embedder, "inner",
+                                              None)):
+            flush = getattr(target, "flush_state", None)
+            if callable(flush):
+                flush()
+                break
+        stats_fn = getattr(self.store, "stats", None)
+        if callable(stats_fn):
+            snap = stats_fn()
+            self.stats["store"] = {
+                k: snap[k] for k in
+                ("ntotal", "index", "tiered", "hbm_resident_fraction",
+                 "pager_hbm_hit_rate", "tier_promotions",
+                 "tier_demotions") if k in snap}
         _LOG.info("ingest done: %s (%.0f embeddings/s)", self.stats, rate)
         return dict(self.stats)
 

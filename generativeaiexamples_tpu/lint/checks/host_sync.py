@@ -49,6 +49,10 @@ HOT_DEFAULTS = {
     # replica's dispatch, not just one engine's.
     "router.py": {"place", "_choose", "_score", "_apply_reports"},
     "fleet.py": {"submit", "_on_event"},
+    # The tiered-ANN search side (ops/tiered.py): one device dispatch
+    # plus host-side miss refine/merge per logical search — a stray
+    # sync here serializes every retrieval caller behind the pager.
+    "tiered.py": {"search", "_host_refine", "_merge"},
 }
 DEVICE_NAME_RE = re.compile(r"(^|_)dev(_|$)|device", re.IGNORECASE)
 NUMPY_MODULES = ("np", "numpy", "onp")

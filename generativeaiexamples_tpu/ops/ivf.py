@@ -106,36 +106,18 @@ def kmeans_fit(data, nlist: int, *, iters: int = 8, seed: int = 0
     return np.asarray(c), np.asarray(assign)
 
 
-def balanced_assign(data: np.ndarray, centroids: np.ndarray, *,
-                    cap_factor: float = BALANCE_CAP,
-                    candidates: int = BALANCE_CANDIDATES) -> np.ndarray:
-    """Capacity-capped assignment: rows claim their nearest centroid in
-    best-distance order; a full partition spills the row to its next
-    nearest with room (then to the globally emptiest — rare). Bounds
-    every list at cap_factor * N/nlist, which bounds the padded refine
-    width the search gather pays for."""
-    data = np.asarray(data, np.float32)
-    centroids = np.asarray(centroids, np.float32)
-    n, nlist = len(data), len(centroids)
-    cap = int(cap_factor * n / nlist) + 1
-    candidates = min(candidates, nlist)
-    # Chunked distance computation keeps peak memory at ~chunk x nlist.
-    order = np.empty((n, candidates), np.int32)
-    best = np.empty((n,), np.float32)
-    c2 = (centroids * centroids).sum(1)
-    for lo in range(0, n, 8192):
-        chunk = data[lo:lo + 8192]
-        d2 = c2 - 2.0 * (chunk @ centroids.T)
-        top = np.argpartition(d2, candidates - 1, axis=1)[:, :candidates]
-        rows = np.arange(len(chunk))[:, None]
-        top = np.take_along_axis(
-            top, np.argsort(d2[rows, top], axis=1), axis=1)
-        order[lo:lo + 8192] = top
-        best[lo:lo + 8192] = d2[np.arange(len(chunk)), top[:, 0]]
-    # Vectorized rank rounds (a per-row Python loop is minutes of host
-    # time at the 10M-row design point): round r offers every still-
-    # unplaced row its r-th nearest centroid; within a partition, slots
-    # go to rows in best-distance priority order.
+def rank_round_assign(order: np.ndarray, best: np.ndarray, nlist: int,
+                      cap: int) -> np.ndarray:
+    """Capacity-capped assignment over precomputed candidate lists.
+
+    `order` [N, c] holds each row's `c` nearest centroids
+    (nearest-first), `best` [N] its nearest distance. Vectorized rank
+    rounds (a per-row Python loop is minutes of host time at the
+    10M-row design point): round r offers every still-unplaced row its
+    r-th nearest centroid; within a partition, slots go to rows in
+    best-distance priority order. Rows whose every candidate is full
+    land on the globally emptiest partition (rare)."""
+    n, candidates = order.shape
     counts = np.zeros(nlist, np.int64)
     out = np.full(n, -1, np.int32)
     pending = np.argsort(best, kind="stable")  # row ids, priority order
@@ -157,6 +139,56 @@ def balanced_assign(data: np.ndarray, centroids: np.ndarray, *,
         out[i] = p
         counts[p] += 1
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("c",))
+def _chunk_candidates(x: jax.Array, centroids: jax.Array, c: int):
+    d2 = _sq_dists(x, centroids)
+    neg, idx = jax.lax.top_k(-d2, c)
+    return idx.astype(jnp.int32), -neg[:, 0]
+
+
+def centroid_candidates(data: np.ndarray, centroids: np.ndarray, *,
+                        candidates: int = BALANCE_CANDIDATES,
+                        chunk: int = 65536
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-`candidates` nearest centroids per row, computed ON DEVICE
+    in bounded chunks: (order [N, c] int32 nearest-first, best [N] f32
+    nearest squared distance). The host-matmul equivalent inside
+    `balanced_assign` is fine at 100k rows x 512 lists but takes tens
+    of minutes at the tiered design point (10M rows x 16k lists); this
+    is the same arithmetic as one [N,D]x[D,nlist] scan, MXU-shaped."""
+    centroids = np.asarray(centroids, np.float32)
+    c = min(candidates, len(centroids))
+    cd = jnp.asarray(centroids)
+    n = len(data)
+    order = np.empty((n, c), np.int32)
+    best = np.empty((n,), np.float32)
+    for lo in range(0, n, chunk):
+        x = jnp.asarray(np.asarray(data[lo:lo + chunk], np.float32))
+        o, b = _chunk_candidates(x, cd, c)
+        order[lo:lo + chunk] = np.asarray(o)
+        best[lo:lo + chunk] = np.asarray(b)
+    return order, best
+
+
+def balanced_assign(data: np.ndarray, centroids: np.ndarray, *,
+                    cap_factor: float = BALANCE_CAP,
+                    candidates: int = BALANCE_CANDIDATES) -> np.ndarray:
+    """Capacity-capped assignment: rows claim their nearest centroid in
+    best-distance order; a full partition spills the row to its next
+    nearest with room (then to the globally emptiest — rare). Bounds
+    every list at cap_factor * N/nlist, which bounds the padded refine
+    width the search gather pays for. Candidates come from the same
+    device-chunked scan the tiered build uses (one arithmetic, no
+    host/device twin to drift)."""
+    data = np.asarray(data, np.float32)
+    centroids = np.asarray(centroids, np.float32)
+    n, nlist = len(data), len(centroids)
+    cap = int(cap_factor * n / nlist) + 1
+    order, best = centroid_candidates(data, centroids,
+                                      candidates=candidates)
+    return rank_round_assign(order, best, nlist, cap)
 
 
 # -- int8 row quantization (ops/quant.py idiom, per-row scales) --------------

@@ -63,6 +63,20 @@ class Resources:
             self.store.enable_microbatch(
                 max_batch=sv.microbatch_max_batch,
                 max_wait_us=sv.microbatch_max_wait_us)
+        # A lexical embedder that woke up with an empty DF table in
+        # front of a non-empty durable store (no persisted snapshot —
+        # e.g. the corpus was ingested before DF persistence existed,
+        # or by another engine's process) rebuilds IDF state from the
+        # stored chunk text, so embed_query keeps the evaluated TF-IDF
+        # weighting across restarts. The micro-batch wrapper delegates
+        # through `.inner`.
+        lex = getattr(self.embedder, "inner", self.embedder)
+        if getattr(lex, "n_docs", None) == 0 \
+                and hasattr(lex, "fit_documents") \
+                and hasattr(self.store, "snapshot_docs") \
+                and len(self.store):
+            lex.fit_documents(
+                [d["text"] for d in self.store.snapshot_docs()])
         self.splitter = get_text_splitter(config)
         self.retriever = Retriever(
             self.store, self.embedder,

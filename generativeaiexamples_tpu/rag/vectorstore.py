@@ -265,6 +265,14 @@ class MemoryVectorStore(MicroBatchHost):
                 "ann_scanned_rows": 0,
                 "ann_recall_est": None,
                 "index_rebuilds": 0,
+                # Tiered-ANN pager gauges (ops/tiered.py). Always
+                # present so /metrics consumers never key-miss; live
+                # values only when TPUVectorStore runs a tiered index.
+                "tiered": False,
+                "hbm_resident_fraction": None,
+                "pager_hbm_hit_rate": None,
+                "tier_promotions": 0,
+                "tier_demotions": 0,
                 # Errors swallowed on background threads; the exact
                 # stores run none, the TPU store counts trainer /
                 # slow-worker failures here.
@@ -336,7 +344,17 @@ class MemoryVectorStore(MicroBatchHost):
             # Usually construction-time, but load() on a shared store
             # must not let a concurrent search see vecs/docs mid-swap.
             with self._lock:
-                self._vecs = np.load(vp)["vecs"].astype(np.float32)
+                loaded = np.load(vp)["vecs"].astype(np.float32)
+                if loaded.size and loaded.shape[1] != self.dim:
+                    raise ValueError(
+                        f"persisted store at {path} holds "
+                        f"{loaded.shape[1]}-dim vectors but this store is "
+                        f"configured for dim={self.dim}; re-ingest the "
+                        f"corpus or fix embeddings.dimensions (older "
+                        f"builds silently widened the lexical engine's "
+                        f"dim to >=1024, so a pre-upgrade corpus may be "
+                        f"wider than today's config)")
+                self._vecs = loaded
                 with open(dp) as fh:
                     self._docs = [json.loads(ln) for ln in fh if ln.strip()]
                 self._load_extra(path)
@@ -367,7 +385,17 @@ class TPUVectorStore(MemoryVectorStore):
     trigger a rebuild, and `quantize_int8` stores rows as int8 +
     per-row scales (1/4 the f32 HBM footprint). With a mesh, flat uses
     ShardedMIPSIndex and IVF uses ShardedIVFIndex (partitions split
-    across the mesh axis)."""
+    across the mesh axis).
+
+    `tiered=True` (requires ivf, single-device) swaps in the
+    demand-paged TieredIVFIndex (ops/tiered.py): HBM holds only the
+    most-probed partitions inside `hbm_budget_mb`, the rest pages
+    through a host-RAM warm cache and an mmap'd disk spill, adds land
+    in warm tail slots with zero device traffic, and a single-flight
+    background pass (kicked after searches) promotes/demotes
+    partitions by probe-frequency EMA and compacts tails. Pager gauges
+    (hbm_resident_fraction / pager_hbm_hit_rate / tier_promotions /
+    tier_demotions) ride `stats()` and the chain-server /metrics."""
 
     def _group_pad(self, n: int) -> int:
         # Coalesced micro-batch groups round up to the next power of
@@ -380,10 +408,28 @@ class TPUVectorStore(MemoryVectorStore):
                  shard_axis: str = "tensor",
                  persist_dir: Optional[str] = None, *,
                  index_type: str = "flat", nlist: int = 64,
-                 nprobe: int = 16, quantize_int8: bool = False):
+                 nprobe: int = 16, quantize_int8: bool = False,
+                 tiered: bool = False, hbm_budget_mb: int = 256,
+                 ram_budget_mb: int = 1024,
+                 spill_dir: Optional[str] = None,
+                 pager_ema_decay: float = 0.98):
         if index_type not in ("flat", "ivf"):
             raise ValueError(
                 f"index_type={index_type!r} not supported; use flat | ivf")
+        if tiered and index_type != "ivf":
+            raise ValueError(
+                "vector_store.tiered requires index_type=ivf (the tiered "
+                "index pages IVF partitions; there is no tiered flat scan)")
+        if tiered and mesh is not None:
+            raise ValueError(
+                "vector_store.tiered is single-device (HBM is the hot "
+                "CACHE tier); unset the mesh or tiered")
+        self.tiered = bool(tiered)
+        self.hbm_budget_mb = int(hbm_budget_mb)
+        self.ram_budget_mb = int(ram_budget_mb)
+        self._spill_dir_cfg = spill_dir or None
+        self._spill_dir_tmp = None  # lazily created for ephemeral stores
+        self.pager_ema_decay = float(pager_ema_decay)
         self.mesh = mesh
         self.shard_axis = shard_axis
         self.index_type = index_type
@@ -648,7 +694,17 @@ class TPUVectorStore(MemoryVectorStore):
                       quantize_int8=self.quantize_int8,
                       centroids=state.get("centroids"),
                       assignments=state.get("assignments"))
-            if self.mesh is not None:
+            if self.tiered:
+                from generativeaiexamples_tpu.ops.tiered import (
+                    TieredIVFIndex)
+
+                built = TieredIVFIndex(
+                    norm, nlist,
+                    hbm_budget_bytes=self.hbm_budget_mb << 20,
+                    ram_budget_bytes=self.ram_budget_mb << 20,
+                    spill_dir=self._tier_spill_dir(),
+                    ema_decay=self.pager_ema_decay, **kw)
+            elif self.mesh is not None:
                 built = ivf_ops.ShardedIVFIndex(norm, nlist, self.mesh,
                                                 self.shard_axis, **kw)
             else:
@@ -680,6 +736,44 @@ class TPUVectorStore(MemoryVectorStore):
             return
         if sidecar is not None:
             self._write_sidecar(sidecar)
+
+    def _tier_spill_dir(self) -> str:
+        """Where the tiered index spills cold partition blocks:
+        configured spill_dir > a `tiered/` subdir of persist_dir > a
+        per-store temp directory (ephemeral corpora still need a cold
+        tier — that is what makes HBM/RAM budgets honest)."""
+        if self._spill_dir_cfg:
+            return self._spill_dir_cfg
+        if self.persist_dir:
+            return os.path.join(self.persist_dir, "tiered")
+        if self._spill_dir_tmp is None:
+            import shutil
+            import tempfile
+            import weakref
+
+            self._spill_dir_tmp = tempfile.mkdtemp(prefix="gaie_tiered_")
+            # Corpus-sized spill files must not outlive the store:
+            # reclaim the temp dir when the store is collected (or at
+            # interpreter exit) — mkdtemp alone would leak one
+            # corpus-sized directory per ephemeral tiered store.
+            weakref.finalize(self, shutil.rmtree, self._spill_dir_tmp,
+                             ignore_errors=True)
+        return self._spill_dir_tmp
+
+    def _maybe_kick_tier_maintenance(self) -> None:
+        """Hand the tiered index's pager/compactor one single-flight
+        background pass when it says work is due. Called AFTER the
+        store lock drops (the pass itself builds off-lock and installs
+        under the index's own tier lock) — searches never stall behind
+        a tier move."""
+        ivf = self._ivf
+        if ivf is not None and hasattr(ivf, "maintenance_due") \
+                and ivf.maintenance_due():
+            ivf.kick_maintenance(on_error=self._note_bg_error)
+
+    def _note_bg_error(self) -> None:
+        with self._slow_lock:
+            self._bg_errors += 1
 
     # -- search ------------------------------------------------------------
 
@@ -809,6 +903,7 @@ class TPUVectorStore(MemoryVectorStore):
             sample = self._pop_pending_sample()
             sidecar = self._pop_pending_sidecar()
         self._flush_slow_work(sample, sidecar, asynchronously=defer_async)
+        self._maybe_kick_tier_maintenance()
         return out
 
     def _search_batch_direct(self, qs: np.ndarray, top_k: int,
@@ -838,6 +933,7 @@ class TPUVectorStore(MemoryVectorStore):
             sample = self._pop_pending_sample()
             sidecar = self._pop_pending_sidecar()
         self._flush_slow_work(sample, sidecar, asynchronously=defer_async)
+        self._maybe_kick_tier_maintenance()
         return out
 
     def _flush_slow_work(self, sample, sidecar, *,
@@ -915,8 +1011,28 @@ class TPUVectorStore(MemoryVectorStore):
                                    if self._recall_n else None),
                 "index_rebuilds": self._rebuilds,
                 "background_errors": self._bg_errors,
+                "tiered": self.tiered,
             })
-            return out
+            ivf = self._ivf
+        # Pager gauges read OFF the store lock: the index has its own
+        # tier lock, and nesting store->tier here while searches nest
+        # the other way would be the classic inversion shape.
+        if ivf is not None and hasattr(ivf, "tier_stats"):
+            ts = ivf.tier_stats()
+            out.update({
+                "index": "ivf_tiered",
+                "hbm_resident_fraction": ts["hbm_resident_fraction"],
+                "pager_hbm_hit_rate": ts["pager_hbm_hit_rate"],
+                "tier_promotions": ts["tier_promotions"],
+                "tier_demotions": ts["tier_demotions"],
+                "tier_compactions": ts["tier_compactions"],
+                "tier_tail_rows": ts["tier_tail_rows"],
+                "tier_warm_bytes": ts["tier_warm_bytes"],
+                "tier_spill_bytes": ts["tier_spill_bytes"],
+                "tier_hot_slots": ts["tier_hot_slots"],
+                "hbm_resident_rows": ts["hbm_resident_rows"],
+            })
+        return out
 
     # -- persistence -------------------------------------------------------
 
@@ -992,7 +1108,12 @@ def create_vector_store(config, dim: Optional[int] = None, mesh=None,
         return TPUVectorStore(dim, mesh=mesh, persist_dir=persist_dir,
                               index_type=vs.index_type, nlist=vs.nlist,
                               nprobe=vs.nprobe,
-                              quantize_int8=vs.quantize_int8)
+                              quantize_int8=vs.quantize_int8,
+                              tiered=vs.tiered,
+                              hbm_budget_mb=vs.hbm_budget_mb,
+                              ram_budget_mb=vs.ram_budget_mb,
+                              spill_dir=vs.spill_dir or None,
+                              pager_ema_decay=vs.pager_ema_decay)
     if name == "memory" or (ephemeral and name in ("milvus", "pgvector")):
         return MemoryVectorStore(dim, persist_dir=persist_dir)
     raise ValueError(

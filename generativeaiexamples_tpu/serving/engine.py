@@ -70,6 +70,14 @@ from generativeaiexamples_tpu.utils.tokenizer import StreamDetokenizer
 
 _LOG = logging.getLogger(__name__)
 
+# Device memory_stats() is refreshed every Nth slot retirement (and on
+# the first): on a remote/tunneled device runtime the call is a
+# blocking RPC, and _mark_done runs on the scheduler thread — a
+# per-retirement query would tax the hot path by the tunnel RTT.
+# Retired slots in between decorate their spans with the cached
+# reading.
+MEMSTATS_SAMPLE_EVERY = 32
+
 
 def _to_host(blk):
     """Device block -> host numpy; speculative blocks are
@@ -454,6 +462,10 @@ class LLMEngine:
         self._wake = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Sampled device memory_stats for span enrichment (see
+        # MEMSTATS_SAMPLE_EVERY). Scheduler-thread-only state.
+        self._memstats_cache: Optional[dict] = None
+        self._memstats_tick = 0
         self._rng = jax.random.PRNGKey(0)
         # Device-resident current token per slot (decode blocks chain
         # through it; the host only reads tokens one block behind).
@@ -2394,18 +2406,29 @@ class LLMEngine:
             slot.span.set_attribute("tokens_generated", slot.generated)
             # Device memory stats where the runtime exposes them
             # (reference parity: system metrics ride every span end;
-            # host CPU/RSS attach inside ManualSpan.end()).
-            try:
-                stats = jax.devices()[0].memory_stats() or {}
-                for key in ("bytes_in_use", "peak_bytes_in_use",
-                            "bytes_limit"):
-                    if key in stats:
-                        slot.span.set_attribute(f"device.{key}", stats[key])
-            except Exception:
-                # Best-effort span enrichment (some backends expose no
-                # memory_stats) — but never silently: this runs on the
-                # scheduler thread, where a swallowed error pattern
-                # would also hide real regressions.
-                _LOG.debug("device memory_stats unavailable for span",
-                           exc_info=True)
+            # host CPU/RSS attach inside ManualSpan.end()). The query
+            # can be a blocking runtime RPC on a remote device, so it
+            # is SAMPLED (first retirement, then every
+            # MEMSTATS_SAMPLE_EVERY) and the cached reading decorates
+            # the spans in between — span enrichment should never cost
+            # the scheduler thread a round trip per retired slot.
+            self._memstats_tick += 1
+            if self._memstats_cache is None or \
+                    self._memstats_tick % MEMSTATS_SAMPLE_EVERY == 1:
+                try:
+                    self._memstats_cache = dict(
+                        jax.devices()[0].memory_stats() or {})
+                except Exception:
+                    # Best-effort span enrichment (some backends expose
+                    # no memory_stats) — but never silently: this runs
+                    # on the scheduler thread, where a swallowed error
+                    # pattern would also hide real regressions.
+                    self._memstats_cache = {}
+                    _LOG.debug("device memory_stats unavailable for span",
+                               exc_info=True)
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in self._memstats_cache:
+                    slot.span.set_attribute(f"device.{key}",
+                                            self._memstats_cache[key])
             slot.span.end()

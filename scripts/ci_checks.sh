@@ -22,7 +22,12 @@
 #                   (scripts/smoke_router.py: routed streams byte-
 #                   identical to a single engine, prefix hit on turn 2,
 #                   graceful drain finishes the in-flight stream).
-#   6. tier-1 tests — the ROADMAP.md pytest gate.
+#   6. tiered-ANN smoke — CPU gate for the demand-paged IVF index
+#                   (scripts/smoke_tiered_ann.py: recall@4 > 0.8 with a
+#                   forced tiny HBM budget so the pager actually pages,
+#                   promotions observed, live writes race searches,
+#                   tiered ids == plain-IVF ids).
+#   7. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -49,6 +54,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "router smoke (JAX_PLATFORMS=cpu scripts/smoke_router.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_router.py || fail=1
+
+    step "tiered-ANN smoke (JAX_PLATFORMS=cpu scripts/smoke_tiered_ann.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_tiered_ann.py || fail=1
 
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -243,6 +243,7 @@ def build_schema() -> dict:
                         "backend": {"type": "string"},
                         "index": {"type": "string",
                                   "description": "flat | ivf | "
+                                                 "ivf_tiered | "
                                                  "flat(ivf pending)"},
                         "ntotal": {"type": "integer"},
                         "searches": {"type": "integer"},
@@ -251,6 +252,15 @@ def build_schema() -> dict:
                         "ann_scanned_rows": {"type": "integer"},
                         "ann_recall_est": {"type": ["number", "null"]},
                         "index_rebuilds": {"type": "integer"},
+                        "tiered": {"type": "boolean"},
+                        "hbm_resident_fraction":
+                            {"type": ["number", "null"],
+                             "description": "tiered-ANN pager gauge: "
+                                            "< 1.0 means HBM is a cache "
+                                            "over the corpus"},
+                        "pager_hbm_hit_rate": {"type": ["number", "null"]},
+                        "tier_promotions": {"type": "integer"},
+                        "tier_demotions": {"type": "integer"},
                     },
                 },
             },

@@ -97,6 +97,40 @@ def test_retrieval_metrics_rank_and_mrr():
     assert out["hit_at_1"] == pytest.approx(1 / 3)
     assert out["hit_at_k"] == pytest.approx(2 / 3)
     assert out["mrr"] == pytest.approx((1.0 + 0.5 + 0.0) / 3)
+    # Homogeneous depths: k == k_min and the two hit@k metrics agree.
+    assert out["k"] == out["k_min"] == 2
+    assert out["hit_at_k_min"] == out["hit_at_k"]
+
+
+def test_retrieval_metrics_heterogeneous_depths():
+    """Regression (ADVICE r7): rows retrieved at different depths used
+    to aggregate into one number labeled hit@k with k = MAX depth —
+    overstating what shallow rows were scored at. The report now
+    carries k_min and hit_at_k_min, the fixed-depth number every row
+    actually reaches."""
+    from generativeaiexamples_tpu.eval.metrics import eval_retrieval
+
+    gt = "the page pool shards on kv heads across the tensor axis"
+    filler = "completely unrelated chunk text"
+    rows = [
+        # depth 2, hit at rank 2 (inside every row's depth)
+        {"ground_truth_context": gt, "retrieved_context": [filler, gt]},
+        # depth 5, hit at rank 4 — counted by hit_at_k, but NOT a hit
+        # at the comparable fixed depth k_min=2
+        {"ground_truth_context": gt,
+         "retrieved_context": [filler, filler, filler, gt, filler]},
+        # depth 5, miss everywhere
+        {"ground_truth_context": gt,
+         "retrieved_context": [filler] * 5},
+    ]
+    out = eval_retrieval(rows)
+    assert out["k"] == 5
+    assert out["k_min"] == 2
+    assert out["hit_at_k"] == pytest.approx(2 / 3)
+    assert out["hit_at_k_min"] == pytest.approx(1 / 3)
+    # Empty input keeps the full (null) key set.
+    empty = eval_retrieval([])
+    assert empty["hit_at_k_min"] is None and empty["k_min"] == 0
 
 
 def test_containment_tolerates_chunk_padding():

@@ -163,3 +163,33 @@ class TestPipeline:
             [FileSource([str(tmp_path / "*.txt")])], batch=512)
         stats = pipe.run()
         assert stats["embeddings"] == len(store) == 1
+
+
+class TestPipelinedSink:
+    def test_store_crash_propagates_not_deadlocks(self, tmp_path):
+        """The embed/store handoff is a bounded queue: when store.add
+        crashes, the producer racing a put against the dead sink must
+        surface the error instead of blocking forever on a full queue."""
+        import pytest
+
+        class BoomStore:
+            def add(self, texts, embs, metas):
+                raise RuntimeError("disk full")
+
+        (tmp_path / "doc.txt").write_text(
+            "words " * 400)  # several chunks -> several batches
+        pipe = IngestPipeline(
+            [FileSource([str(tmp_path / "*.txt")])],
+            RecursiveCharacterSplitter(120, 0), HashEmbedder(32),
+            BoomStore(), embed_batch=1)
+        with pytest.raises(RuntimeError, match="disk full"):
+            pipe.run()
+
+    def test_stats_carry_rate_and_store_snapshot(self, tmp_path):
+        (tmp_path / "doc.txt").write_text("a document about paging")
+        pipe, store = make_pipeline(
+            [FileSource([str(tmp_path / "*.txt")])])
+        stats = pipe.run()
+        assert stats["embeddings_per_s"] > 0
+        assert stats["store"]["ntotal"] == len(store)
+        assert stats["store"]["tiered"] is False

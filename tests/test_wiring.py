@@ -238,3 +238,84 @@ def test_tokens_per_sec_is_sliding_window():
     m2.started -= 3600  # engine "started an hour ago"
     m2.record_tokens(500)
     assert m2.tokens_per_sec(window_s=30.0) > 100
+
+
+# -- lexical DF persistence (ADVICE r7: cross-process IDF state) ------------
+
+
+def _lexical_cfg(tmp_path, dim=1024):
+    cfg = load_config(path="", env={})
+    return replace(
+        cfg,
+        embeddings=replace(cfg.embeddings, model_engine="lexical",
+                           dimensions=dim),
+        vector_store=replace(cfg.vector_store,
+                             persist_dir=str(tmp_path / "store")))
+
+
+def test_lexical_df_persists_across_restarts(tmp_path):
+    """The IDF state learned at ingest time survives a restart: a fresh
+    factory-built embedder (process-equivalent) reloads the DF snapshot
+    persisted alongside the store, so embed_query keeps TF-IDF
+    weighting instead of silently degrading to plain TF."""
+    import numpy as np
+
+    from generativeaiexamples_tpu.connectors.factory import get_embedder
+
+    cfg = _lexical_cfg(tmp_path)
+    emb1 = get_embedder(cfg)
+    emb1.embed_documents(["tpu pods stack chips", "chips share hbm",
+                          "the pods run jax"])
+    assert emb1.n_docs == 3
+    q1 = emb1.embed_query("which chips share hbm")
+
+    emb2 = get_embedder(cfg)  # brand-new process equivalent
+    assert emb2.n_docs == 3
+    assert np.allclose(emb2.embed_query("which chips share hbm"), q1)
+
+    # Without persistence the same restart degrades to plain TF.
+    cfg_np = replace(cfg, vector_store=replace(cfg.vector_store,
+                                               persist_dir=""))
+    emb3 = get_embedder(cfg_np)
+    assert emb3.n_docs == 0
+    assert not np.allclose(emb3.embed_query("which chips share hbm"), q1)
+
+
+def test_lexical_df_rebuilds_from_store_chunk_text(tmp_path):
+    """No DF snapshot (corpus ingested before persistence existed, or
+    by another engine): Resources rebuilds the DF table from the stored
+    chunk text at startup."""
+    import os
+
+    from generativeaiexamples_tpu.connectors.lexical import LexicalEmbedder
+    from generativeaiexamples_tpu.rag.vectorstore import MemoryVectorStore
+
+    cfg = _lexical_cfg(tmp_path)
+    seed_emb = LexicalEmbedder(1024)
+    store = MemoryVectorStore(1024,
+                              persist_dir=cfg.vector_store.persist_dir)
+    texts = ["tpu pods stack chips", "chips share hbm"]
+    store.add(texts, seed_emb.embed_documents(texts),
+              [{"filename": "a.txt"}] * 2)
+    df_path = os.path.join(cfg.vector_store.persist_dir,
+                           "lexical_df.json")
+    if os.path.exists(df_path):
+        os.unlink(df_path)  # simulate a pre-persistence corpus
+
+    res = Resources(cfg, llm=EchoLLM())
+    assert res.embedder.n_docs == 2
+    # ... and the rebuild itself persisted, so the NEXT restart skips it.
+    assert os.path.exists(df_path)
+
+
+def test_lexical_honors_configured_dimensions(tmp_path):
+    """ADVICE r7: the factory must not silently widen
+    embeddings.dimensions for the lexical engine — honor it, or fail
+    loudly at load when it cannot be honored."""
+    from generativeaiexamples_tpu.connectors.factory import get_embedder
+
+    cfg = _lexical_cfg(tmp_path, dim=384)
+    assert get_embedder(cfg).dim == 384
+
+    with pytest.raises(ValueError, match="dimensions"):
+        get_embedder(_lexical_cfg(tmp_path, dim=4))
