@@ -30,7 +30,11 @@ tune caller count, requests per caller, corpus size),
 BENCH_FLEET (0 skips; BENCH_FLEET_REPLICAS / _REQS / _THREADS /
 _PROMPT / _GEN / _CONVS tune replica count and the burst /
 conversation-replay workloads — the scenario runs in a child process
-pinned to the CPU backend, see scripts/bench_fleet.py).
+pinned to the CPU backend, see scripts/bench_fleet.py),
+BENCH_QOS (0 skips; BENCH_QOS_SEED / _HORIZON_S / _BATCH_REQUESTS /
+_LATENCY_RPS / _SLO_TTFT_MS tune the replayed bursty multi-tenant
+trace and the latency-tier SLO — also a CPU-backend child process,
+see scripts/bench_qos.py).
 
 Flags: --repeat N runs the headline decode burst N times and reports
 the MEDIAN as the headline value, with per-run values and spread under
@@ -105,6 +109,22 @@ Scenario output keys (under "extras"):
                  cores, not a second chip; on a 1-core container
                  fleet_speedup honestly reads contention, keyed by
                  fleet_cpu_count. BENCH_FLEET=0 skips)
+  QoS goodput:   qos_goodput_latency_tier, qos_goodput_batch_tier,
+                 qos_shed_rate, qos_fifo_goodput_baseline,
+                 qos_preemptions, qos_fifo_goodput_batch,
+                 qos_latency_ttft_p95_ms, qos_fifo_ttft_p95_ms,
+                 qos_slo_ttft_ms, qos_trace_requests,
+                 qos_shed_reject_ms (goodput under SLO — the fraction
+                 of requests meeting per-tier TTFT / gap / completion
+                 targets — on a seeded bursty multi-tenant trace
+                 (batch-tier flood + latency-tier Poisson arrivals,
+                 serving/qos.py) replayed against the FIFO scheduler
+                 vs engine.qos weighted-fair scheduling + prefill
+                 preemption, plus the edge 429-shedding probe; the
+                 production-traffic gate. Runs as a CPU-backend child
+                 (scripts/bench_qos.py) — it measures scheduling
+                 policy under wall-clock arrivals, not chip speed.
+                 BENCH_QOS=0 skips)
 
 `python bench.py --help` prints this header and exits.
 
@@ -532,6 +552,19 @@ def main() -> None:
         except Exception as e:
             fleet_stats = {"fleet_error": f"{type(e).__name__}: {e}"}
 
+    # -- QoS goodput under SLO (ISSUE 9 tentpole — the production-
+    # traffic gate): a seeded bursty multi-tenant trace replayed
+    # against FIFO vs the weighted-fair scheduler; per-tier goodput,
+    # preemption and edge-shed keys. CPU-backend child like the fleet
+    # scenario: the subject is scheduling policy under wall-clock
+    # arrival timing, not chip throughput.
+    qos_stats = {}
+    if os.environ.get("BENCH_QOS", "1") != "0":
+        try:
+            qos_stats = _bench_qos()
+        except Exception as e:
+            qos_stats = {"qos_error": f"{type(e).__name__}: {e}"}
+
     tps = statistics.median(tps_runs)
     out = {
         "metric": f"decode_tokens_per_sec_per_chip_llama3_{model}"
@@ -574,6 +607,7 @@ def main() -> None:
             **tiered_stats,
             **concurrent_stats,
             **fleet_stats,
+            **qos_stats,
         },
     }
     # Provenance is pinned: the scenario refuses to emit an artifact
@@ -591,11 +625,24 @@ def main() -> None:
 def _bench_fleet():
     """Spawn scripts/bench_fleet.py on the CPU backend and merge its
     one-line JSON result (BENCH_FLEET_* env knobs pass through)."""
+    return _cpu_child_scenario("bench_fleet.py", "fleet_error")
+
+
+def _bench_qos():
+    """Spawn scripts/bench_qos.py on the CPU backend and merge its
+    one-line JSON result (BENCH_QOS_* env knobs pass through)."""
+    return _cpu_child_scenario("bench_qos.py", "qos_error")
+
+
+def _cpu_child_scenario(script_name: str, error_key: str):
+    """Run a scripts/ scenario as a CPU-pinned child process and parse
+    its one-line JSON output (shared by the fleet and QoS scenarios —
+    both measure host-side behavior, not chip throughput)."""
     import subprocess
     import sys as _sys
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "bench_fleet.py")
+                          "scripts", script_name)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run([_sys.executable, script], env=env,
@@ -603,8 +650,7 @@ def _bench_fleet():
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     if proc.returncode != 0 or not lines:
         tail = (proc.stderr or proc.stdout or "").strip()[-400:]
-        return {"fleet_error": f"bench_fleet.py rc={proc.returncode}: "
-                               f"{tail}"}
+        return {error_key: f"{script_name} rc={proc.returncode}: {tail}"}
     return json.loads(lines[-1])
 
 

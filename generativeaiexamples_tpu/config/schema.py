@@ -312,6 +312,30 @@ class EngineConfig:
     # pages (LRU-trimmed beyond this; allocator pressure evicts
     # further — live sequences always win over the cache).
     prefix_cache_capacity: float = 0.5
+    # SLO-aware multi-tenant QoS (serving/qos.py): requests carry a
+    # priority tier (latency | standard | batch — body `priority` field
+    # or x-priority header) and a tenant id (OpenAI `user` field /
+    # x-tenant-id header); admission replaces the FIFO queue with
+    # weighted-fair scheduling across tiers (service-per-weight, so
+    # batch is throttled under latency pressure but never starved) and
+    # least-served-tenant fairness within a tier, and latency-tier
+    # arrivals in their TTFT phase pause lower-tier long prefills at
+    # the fused-rider beat boundary (the chunk simply stops being
+    # dispatched; resume is byte-identical — chunk state is snapshot-
+    # based). Off by default — off is byte-identical to the FIFO
+    # scheduler.
+    qos: bool = False
+    # Admission-bandwidth weights per tier (floored at 1 — a zero
+    # weight would re-create starvation). Latency : standard : batch
+    # defaults 8 : 4 : 1.
+    qos_weight_latency: int = 8
+    qos_weight_standard: int = 4
+    qos_weight_batch: int = 1
+    # With qos on, pause lower-tier in-progress long prefills while a
+    # latency-tier request is in its TTFT phase (prefilling or awaiting
+    # its first token) — the preemption that keeps a tenant's 8k flood
+    # from sitting in front of every interactive caller.
+    qos_preempt_prefill: bool = True
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
 
@@ -337,10 +361,26 @@ class ServingConfig:
     # dispatch); idle single requests pay at most this once.
     microbatch_max_wait_us: int = 2000
     # ThreadPoolExecutor width for the chain server's blocking chain /
-    # ingest / search work. Must comfortably exceed
-    # microbatch_max_batch, or concurrency caps below the batch window
-    # and coalescing can never fill a dispatch.
+    # ingest / search work (and the OpenAI server's stream bridging —
+    # each live SSE stream parks one thread on a blocking queue.get).
+    # Must comfortably exceed microbatch_max_batch, or concurrency caps
+    # below the batch window and coalescing can never fill a dispatch.
     executor_workers: int = 64
+    # Edge admission control (serving/qos.py EdgeAdmission): bound the
+    # requests in flight PER TIER at the OpenAI server; past the bound
+    # a request is shed with 429 + Retry-After before it queues on the
+    # engine — overload costs the caller one RTT, not an unbounded
+    # wait. Off by default (no shedding; depth still tracked).
+    qos_edge: bool = False
+    # Per-tier in-flight bounds (0 = unbounded for that tier). The
+    # latency bound should sit near the engine's slot count — a
+    # latency request that would queue deeper than that has already
+    # missed its TTFT target, so shedding it fast is the honest answer.
+    qos_bound_latency: int = 32
+    qos_bound_standard: int = 64
+    qos_bound_batch: int = 128
+    # Retry-After hint (seconds) on shed responses.
+    qos_retry_after_s: float = 1.0
 
 
 @dataclass(frozen=True)

@@ -39,10 +39,21 @@ HOT_DEFAULTS = {
     # The StepPlan dispatch path (engine.py PR-6 refactor): plan
     # selection + the single plan_step lowering replaced the old
     # per-lane _dispatch_decode_spec/_dispatch_fused_rider functions.
+    # The QoS admission/preemption path (serving/qos.py policy layer):
+    # tier selection runs inside _admit_waiting under the waiting
+    # lock, preemption refresh runs once per scheduler beat — a host
+    # sync in either stalls every tier, which defeats the point of
+    # having tiers.
     "engine.py": {"_loop", "_admit_waiting", "_dispatch_decode",
                   "_select_plan", "_dispatch_plan", "_rider_candidate",
-                  "_advance_long_prefills", "_emit_ready_first_tokens"},
+                  "_advance_long_prefills", "_emit_ready_first_tokens",
+                  "_qos_pop_waiting", "_qos_refresh_preemption",
+                  "_qos_latency_pressure"},
     "batcher.py": {"_loop", "_run", "_take_group"},
+    # QoS policy layer (serving/qos.py): pick/note_admitted run under
+    # the engine's waiting lock on the scheduler thread, try_admit on
+    # every server request thread.
+    "qos.py": {"pick", "note_admitted", "try_admit"},
     # The fleet request path (serving/router.py + serving/fleet.py):
     # placement and the per-event stream hook run on server request /
     # engine scheduler threads — a host sync there stalls every

@@ -167,7 +167,8 @@ def main() -> None:
     cfg = load_config(args.config)
     llm, emb, rr = build_engines(cfg, args.model_size)
     server = OpenAIServer(llm, emb, rr, model_name=cfg.llm.model_name,
-                          embed_model_name=cfg.embeddings.model_name)
+                          embed_model_name=cfg.embeddings.model_name,
+                          serving_cfg=cfg.serving)
     logging.info("engine server on %s:%d (backend=%s)", args.host, args.port,
                  jax.default_backend())
     run_server(server, args.host, args.port)

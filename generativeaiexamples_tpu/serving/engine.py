@@ -66,6 +66,7 @@ from generativeaiexamples_tpu.models.llama import LlamaConfig
 from generativeaiexamples_tpu.serving import engine_model
 from generativeaiexamples_tpu.serving.kv_cache import (
     PageAllocator, PagePool, SequencePages)
+from generativeaiexamples_tpu.serving.qos import request_tier
 from generativeaiexamples_tpu.utils.tokenizer import StreamDetokenizer
 
 _LOG = logging.getLogger(__name__)
@@ -77,6 +78,16 @@ _LOG = logging.getLogger(__name__)
 # Retired slots in between decorate their spans with the cached
 # reading.
 MEMSTATS_SAMPLE_EVERY = 32
+
+# Failed admissions (page exhaustion) a single request may retry
+# before it is failed with an `error` stream event. The cap is a
+# BACKSTOP, not a queue-wait budget: attempts are counted only while
+# nothing in flight could free pages (no live slots, no in-flight
+# blocks) — a request legitimately waiting behind long decodes retries
+# indefinitely, exactly like the pre-cap scheduler. A prompt whose
+# worst case can NEVER fit the pool fails on its first attempt
+# instead (see _admit_waiting).
+MAX_ADMISSION_RETRIES = 64
 
 
 def _to_host(blk):
@@ -111,6 +122,15 @@ class GenRequest:
     # x-session-id header): the router pins a session to the replica
     # holding its conversation KV. Unused by a single engine.
     session_id: str = ""
+    # QoS tier (serving/qos.py: latency | standard | batch; anything
+    # else normalizes to standard) and tenant identity (OpenAI `user`
+    # field / x-tenant-id header). With engine.qos off both are inert.
+    priority: str = "standard"
+    tenant_id: str = ""
+    # Admission attempts that failed on page exhaustion (scheduler
+    # thread only; capped at MAX_ADMISSION_RETRIES so a poison request
+    # cannot spin the scheduler forever).
+    admission_attempts: int = 0
     cancelled: bool = False  # set by the server on client disconnect/stop
     truncate_prompt: bool = False  # opt-in: clamp instead of reject
     trace_context: Any = None  # OTel context from the caller (W3C)
@@ -195,7 +215,7 @@ class _LongPrefill:
     decode traffic, chunks run at full dispatch speed."""
 
     __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot",
-                 "beat", "chunk", "stall_pos")
+                 "beat", "chunk", "stall_pos", "tier", "paused")
 
     def __init__(self, req, slot_idx, seq, ids, cache, slot, chunk):
         self.req = req
@@ -209,6 +229,13 @@ class _LongPrefill:
         # pos observed at the last beat boundary (-1 = not yet seen);
         # drives the prefill_stall_beats counter.
         self.stall_pos = -1
+        # QoS preemption state (engine.qos only): a lower-tier prefill
+        # pauses at the beat boundary while a latency-tier request is
+        # in its TTFT phase — no chunk rides or dispatches until the
+        # pressure clears. Resume is byte-identical: pos + the scratch
+        # cache ARE the chunk state, nothing else moves while paused.
+        self.tier = request_tier(req)
+        self.paused = False
         # Chunk width per forward: the largest bucket for long prompts;
         # prefix-cache hits on short prompts use the suffix's bucket so
         # a small uncached tail never pays a full-width forward.
@@ -260,6 +287,15 @@ class EngineMetrics:
         self.prefix_miss = 0
         self.prefix_evictions = 0
         self.prefix_hit_tokens = 0
+        # QoS counters (serving/qos.py; always present — 0, never
+        # absent, when engine.qos is off): admissions that failed on
+        # page exhaustion (requeued or, past MAX_ADMISSION_RETRIES,
+        # failed), lower-tier long prefills paused for a latency-tier
+        # TTFT phase, and the per-tier waiting-queue depth gauge the
+        # edge/router read for tier pressure.
+        self.admission_failures = 0
+        self.qos_preemptions = 0
+        self.qos_queue_depth = {"latency": 0, "standard": 0, "batch": 0}
         self.started = time.perf_counter()
         # (timestamp, n_tokens) per decode dispatch for the sliding rate.
         self._token_events: deque = deque(maxlen=8192)
@@ -334,6 +370,12 @@ class EngineMetrics:
                                      if self.spec_slot_steps else 0.0),
             "plan_variants_compiled": self.plan_variants_compiled,
             "spec_fallback_steps": self.spec_fallback_steps,
+            "admission_failures": self.admission_failures,
+            "qos_preemptions": self.qos_preemptions,
+            # Copied so a scrape never observes the scheduler mutating
+            # the gauge mid-iteration (dict reads are GIL-atomic, the
+            # copy just freezes the snapshot).
+            "qos_queue_depth": dict(self.qos_queue_depth),
         }
         # Fleet-router counters (serving/router.py): a single engine
         # never routes, but the keys are ALWAYS present — 0/{}, never
@@ -346,6 +388,7 @@ class EngineMetrics:
 
         out.update(dict.fromkeys(ROUTER_COUNTER_KEYS, 0))
         out["router_queue_depth"] = {}
+        out["router_tier_depth"] = {}
         return out
 
 
@@ -451,6 +494,19 @@ class LLMEngine:
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()
         self.metrics = EngineMetrics()
+        # SLO-aware multi-tenant QoS (serving/qos.py): None = the FIFO
+        # admission path, byte-identical to the pre-QoS scheduler. With
+        # engine.qos on, admission order comes from the weighted-fair
+        # TierScheduler and latency-tier TTFT phases pause lower-tier
+        # long prefills at the beat boundary.
+        self.qos = None
+        if self.ecfg.qos:
+            from generativeaiexamples_tpu.serving.qos import TierScheduler
+
+            self.qos = TierScheduler({
+                "latency": self.ecfg.qos_weight_latency,
+                "standard": self.ecfg.qos_weight_standard,
+                "batch": self.ecfg.qos_weight_batch})
         # Buckets drive prefill_step's page-write reshape, so each must be a
         # positive multiple of page_size within max_seq_len; invalid entries
         # are rounded up / dropped here instead of crashing at first request.
@@ -1013,6 +1069,7 @@ class LLMEngine:
             req.prompt_ids = req.prompt_ids[-max_prompt:]
         with self._lock:
             self.waiting.append(req)
+            self._tier_depth(req, +1)
         self._wake.set()
         return req
 
@@ -1226,6 +1283,63 @@ class LLMEngine:
         cap = self.ecfg.max_prefill_group
         return cap if cap > 0 else self.ecfg.max_batch_size
 
+    def _tier_depth(self, req: GenRequest, delta: int) -> None:
+        """Move the per-tier waiting-depth gauge (always maintained —
+        the edge and router read tier pressure from it whether or not
+        engine.qos is on). Called with self._lock held."""
+        d = self.metrics.qos_queue_depth
+        tier = request_tier(req)
+        d[tier] = max(0, d[tier] + delta)
+
+    # graftlint: hot-path
+    def _qos_pop_waiting(self) -> GenRequest:
+        """Weighted-fair admission pop (engine.qos on; self._lock
+        held): the TierScheduler picks the least-served-per-weight
+        tier, the least-served tenant within it, FIFO within the
+        tenant. O(waiting) per pop — the edge bounds keep the queue
+        short; unbounded queues belong to the FIFO path."""
+        idx = self.qos.pick(self.waiting)
+        req = self.waiting[idx]
+        del self.waiting[idx]
+        return req
+
+    # graftlint: hot-path
+    def _qos_refresh_preemption(self) -> None:
+        """Pause/resume in-progress long prefills at the beat boundary
+        (engine.qos + qos_preempt_prefill): while any latency-tier slot
+        is in its TTFT phase, lower-tier prefills stop dispatching
+        chunks AND stop attaching fused riders — the dispatch bandwidth
+        goes to the latency request. Resume is byte-identical: a paused
+        prefill's pos/scratch-cache snapshot simply waits. Idempotent
+        within a scheduler iteration (transitions counted edge-
+        triggered), and a latency-tier prefill itself never pauses."""
+        if self.qos is None or not self._long_prefills \
+                or not self.ecfg.qos_preempt_prefill:
+            return
+        pressure = self._qos_latency_pressure()
+        for lp in self._long_prefills:
+            should = pressure and lp.tier != "latency"
+            if should and not lp.paused:
+                self.metrics.qos_preemptions += 1
+            lp.paused = should
+
+    # graftlint: hot-path
+    def _qos_latency_pressure(self) -> bool:
+        """True while an ADMITTED latency-tier request is prefilling or
+        awaiting its first token. Deliberately not triggered by merely
+        WAITING latency requests: a waiting request either gets a slot
+        this very pass (admission runs before dispatch) or cannot
+        progress regardless — pausing on its behalf could deadlock a
+        prefill that holds the only slot."""
+        for s in self.slots:
+            if s is None or s.req.cancelled:
+                continue
+            if request_tier(s.req) != "latency":
+                continue
+            if s.prefilling or not s.first_emitted:
+                return True
+        return False
+
     def _admit_waiting(self) -> bool:
         """Admit every waiting request with a free slot, grouped by
         prefill bucket into BATCHED prefill dispatches (capped at
@@ -1242,7 +1356,12 @@ class LLMEngine:
                 slot_idx = self._free_slot_index()
                 if slot_idx is None:
                     break
-                req = self.waiting.popleft()
+                # FIFO is the byte-identical default; with engine.qos
+                # the weighted-fair scheduler picks the next admission
+                # across tiers and tenants instead of queue position.
+                req = (self.waiting.popleft() if self.qos is None
+                       else self._qos_pop_waiting())
+                self._tier_depth(req, -1)
             ids = req.prompt_ids or [0]
             long = len(ids) > self.buckets[-1]
             lane_full = len(self._long_prefills) >= self._max_long_prefills
@@ -1274,9 +1393,37 @@ class LLMEngine:
             except MemoryError as e:
                 seq.release()
                 self._release_hit_pin(hit)
+                self.metrics.admission_failures += 1
+                # Poison: the prompt (plus one generated token) needs
+                # more pages than the pool HAS (page 0 is the sink) —
+                # no amount of draining or reclaim ever admits it, and
+                # requeued at the head it would block the whole line.
+                # Fail it now and keep admitting the rest.
+                ps = self.pool.page_size
+                never_fits = -(-(len(ids) + 1) // ps) \
+                    > self.allocator.n_pages - 1
+                # The retry cap only advances while nothing can free
+                # pages (no live slots, nothing in flight): a request
+                # waiting behind long-running decodes is a queue, not a
+                # failure, and retries indefinitely.
+                if not never_fits and not any(
+                        s is not None for s in self.slots) \
+                        and not self._inflight:
+                    req.admission_attempts += 1
+                if never_fits \
+                        or req.admission_attempts >= MAX_ADMISSION_RETRIES:
+                    _LOG.warning(
+                        "admission failed terminally (%s, attempts=%d, "
+                        "never_fits=%s); failing request",
+                        e, req.admission_attempts, never_fits)
+                    req.stream.put({"text": "", "token_id": -1,
+                                    "finished": True,
+                                    "finish_reason": "error"})
+                    continue
                 _LOG.warning("admission failed (%s); requeueing", e)
                 with self._lock:
                     self.waiting.appendleft(req)
+                    self._tier_depth(req, +1)
                 break
             if self.prefix_cache is not None:
                 if hit is None:
@@ -1293,6 +1440,11 @@ class LLMEngine:
             # the real _Slot replaces the placeholder at dispatch.
             placeholder = _Slot(req, seq, None)
             self.slots[slot_idx] = placeholder
+            if self.qos is not None:
+                # Charge the weighted-fair accounting only for REAL
+                # admissions (deferred/requeued requests go back to the
+                # queue uncharged).
+                self.qos.note_admitted(req)
             if hit is not None:
                 try:
                     self._begin_prefix_prefill(req, slot_idx, seq, ids,
@@ -1314,6 +1466,8 @@ class LLMEngine:
         if deferred_long:
             with self._lock:
                 self.waiting.extendleft(reversed(deferred_long))
+                for r in deferred_long:
+                    self._tier_depth(r, +1)
         did = False
         cap = self._prefill_cap
         for bucket, entries in groups.items():
@@ -1560,6 +1714,7 @@ class LLMEngine:
         speculative, when fusing is off, or when the fused variant for
         this scratch shape isn't warmed."""
         did = False
+        self._qos_refresh_preemption()
         decoding = any(s is not None and not s.prefilling
                        for s in self.slots)
         for lp in list(self._long_prefills):
@@ -1571,6 +1726,11 @@ class LLMEngine:
             if lp.req.cancelled:
                 self._long_prefills.remove(lp)
                 self._finish(lp.slot_idx, "cancelled")
+                continue
+            if lp.paused:
+                # QoS preemption: a latency-tier TTFT phase owns the
+                # dispatch bandwidth; this prefill resumes from its
+                # snapshot (pos + scratch cache) once pressure clears.
                 continue
             if decoding and self._fuse_ready(lp):
                 continue  # the next decode dispatch carries the chunk
@@ -1991,9 +2151,11 @@ class LLMEngine:
         scratch wide enough), or None."""
         if not self._fused_width:
             return None
+        self._qos_refresh_preemption()
         for cand in self._long_prefills:
             if (self.slots[cand.slot_idx] is cand.slot
                     and not cand.req.cancelled
+                    and not cand.paused
                     and cand.pos < len(cand.ids)
                     and cand.cache.k.shape[-2] >= self._fused_width):
                 return cand

@@ -64,6 +64,7 @@ _COUNTER_KEYS = (
     "fused_prefill_tokens", "prefill_stall_beats", "prefix_hits",
     "prefix_miss", "prefix_evictions", "prefix_hit_tokens",
     "plan_variants_compiled", "spec_fallback_steps",
+    "admission_failures", "qos_preemptions",
 )
 
 
@@ -140,6 +141,10 @@ class LocalReplica:
         replay into a stream another replica now owns."""
         with self.engine._lock:
             self.engine.waiting.clear()
+            # The purged requests leave the queue without being
+            # admitted: zero the per-tier depth gauge with them.
+            for t in self.engine.metrics.qos_queue_depth:
+                self.engine.metrics.qos_queue_depth[t] = 0
 
     def warmup(self, **kw) -> None:
         self.engine.warmup(**kw)
@@ -253,12 +258,15 @@ class HttpReplica:
 
 class _ReqRecord:
     __slots__ = ("req", "rid", "est", "emitted", "started", "done",
-                 "submitted")
+                 "submitted", "tier")
 
     def __init__(self, req, rid: str):
+        from generativeaiexamples_tpu.serving.qos import request_tier
+
         self.req = req
         self.rid = rid
         self.est = max(1, int(getattr(req, "max_new_tokens", 1) or 1))
+        self.tier = request_tier(req)  # router tier-pressure accounting
         self.emitted = 0      # tokens delivered so far
         self.started = False  # any event delivered (requeue gate)
         self.done = False
@@ -319,6 +327,9 @@ class FleetMetrics:
         lambda self: self._sum("fused_prefill_tokens"))
     prefill_stall_beats = property(
         lambda self: self._sum("prefill_stall_beats"))
+    admission_failures = property(
+        lambda self: self._sum("admission_failures"))
+    qos_preemptions = property(lambda self: self._sum("qos_preemptions"))
 
     def snapshot(self) -> Dict[str, Any]:
         reps = self._fleet.replicas
@@ -350,6 +361,13 @@ class FleetMetrics:
         out["tokens_per_sec"] = tps
         out["spec_tokens_per_step"] = (spec_num / spec_den
                                        if spec_den else 0.0)
+        # Fleet-wide per-tier waiting depth: tier-wise sum over replica
+        # snapshots (same always-present contract as the scalars).
+        qd: Dict[str, int] = {"latency": 0, "standard": 0, "batch": 0}
+        for snap in per_replica.values():
+            for t, v in (snap.get("qos_queue_depth") or {}).items():
+                qd[t] = qd.get(t, 0) + (v or 0)
+        out["qos_queue_depth"] = qd
         # TTFT percentiles merge raw samples (local replicas only —
         # remote snapshots expose only their own percentiles, kept
         # under per_replica).
@@ -433,13 +451,13 @@ class EngineFleet:
         req.stream = _TrackedStream(self, rec)
         with self._lock:
             self._records[rid][id(req)] = rec
-        self.router.note_submitted(rid, rec.est)
+        self.router.note_submitted(rid, rec.est, rec.tier)
         try:
             self._by_rid[rid].submit(req)
         except Exception:
             with self._lock:
                 self._records[rid].pop(id(req), None)
-            self.router.note_finished(rid, rec.est)
+            self.router.note_finished(rid, rec.est, rec.tier)
             raise
         with self._lock:
             rec.submitted = True
@@ -506,7 +524,8 @@ class EngineFleet:
         if ev.get("finished") and not rec.done:
             rec.done = True
             self.router.note_finished(rec.rid,
-                                      max(0, rec.est - rec.emitted))
+                                      max(0, rec.est - rec.emitted),
+                                      rec.tier)
             with self._cond:
                 self._records.get(rec.rid, {}).pop(id(rec.req), None)
                 self._cond.notify_all()
@@ -610,7 +629,7 @@ class EngineFleet:
     def _requeue(self, rec: _ReqRecord) -> bool:
         """Re-place one untouched request from an evicted replica. Its
         tracked stream is kept — no events were delivered."""
-        self.router.note_finished(rec.rid, rec.est)
+        self.router.note_finished(rec.rid, rec.est, rec.tier)
         try:
             rid = self.router.place(rec.req.prompt_ids,
                                     getattr(rec.req, "session_id", ""))
@@ -624,14 +643,14 @@ class EngineFleet:
         rec.rid = rid
         with self._lock:
             self._records[rid][id(rec.req)] = rec
-        self.router.note_submitted(rid, rec.est)
+        self.router.note_submitted(rid, rec.est, rec.tier)
         try:
             self._by_rid[rid].submit(rec.req)
         except Exception as e:
             _LOG.warning("requeue to %s failed: %s", rid, e)
             with self._lock:
                 self._records[rid].pop(id(rec.req), None)
-            self.router.note_finished(rid, rec.est)
+            self.router.note_finished(rid, rec.est, rec.tier)
             rec.done = True  # settled here; _on_event must not repeat it
             rec.req.stream.put(_error_event())
             return False

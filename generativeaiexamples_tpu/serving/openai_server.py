@@ -74,19 +74,44 @@ class StopStream:
 class OpenAIServer:
     def __init__(self, llm_engine=None, embed_engine=None, rerank_engine=None,
                  model_name: str = "llama3-8b-instruct",
-                 embed_model_name: str = "snowflake-arctic-embed-l"):
+                 embed_model_name: str = "snowflake-arctic-embed-l",
+                 serving_cfg=None):
+        from generativeaiexamples_tpu.config.schema import ServingConfig
+        from generativeaiexamples_tpu.serving.qos import EdgeAdmission
+
         self.llm = llm_engine
         self.embed = embed_engine
         self.rerank = rerank_engine
         self.model_name = model_name
         self.embed_model_name = embed_model_name
+        scfg = serving_cfg or ServingConfig()
         # Dedicated executor: each live stream parks one thread on a
         # blocking queue.get; the default loop executor is far too small
         # (min(32, cpu+4)) and shared, so streams would starve embeddings.
+        # Width is the operator's serving.executor_workers with two
+        # floors: the chain server's micro-batch rule (concurrency
+        # below the window means the batcher can never fill a
+        # dispatch), and this server's historical 128 — streams are
+        # thread-parking, so dropping below the old hardcoded width
+        # would silently halve default stream capacity.
         from concurrent.futures import ThreadPoolExecutor
 
-        self._executor = ThreadPoolExecutor(max_workers=128,
+        workers = max(scfg.executor_workers, 128)
+        if scfg.microbatch_enabled:
+            workers = max(workers, 2 * scfg.microbatch_max_batch)
+        self._executor = ThreadPoolExecutor(max_workers=workers,
                                             thread_name_prefix="openai-srv")
+        # Edge admission control (serving/qos.py): per-tier in-flight
+        # bounds; past the bound a request is shed with 429 +
+        # Retry-After BEFORE it queues on the engine. Always
+        # constructed so the /metrics shed counters exist (0, never
+        # absent) when shedding is off.
+        self.edge = EdgeAdmission(
+            bounds={"latency": scfg.qos_bound_latency,
+                    "standard": scfg.qos_bound_standard,
+                    "batch": scfg.qos_bound_batch},
+            retry_after_s=scfg.qos_retry_after_s,
+            enabled=scfg.qos_edge)
         self.app = web.Application()
         self.app.add_routes([
             web.get("/health", self.handle_health),
@@ -119,9 +144,11 @@ class OpenAIServer:
             text = p
         return tk.encode(text, add_bos=not chat)
 
-    def _gen_request(self, body: Dict, chat: bool):
+    def _gen_request(self, body: Dict, chat: bool, headers=None):
         from generativeaiexamples_tpu.serving.engine import GenRequest
+        from generativeaiexamples_tpu.serving.qos import normalize_tier
 
+        headers = headers or {}
         return GenRequest(
             prompt_ids=self._prompt_ids(body, chat),
             max_new_tokens=int(body.get("max_tokens") or 128),
@@ -132,6 +159,13 @@ class OpenAIServer:
             # Fleet session affinity: the OpenAI `user` field is the
             # natural session key; a single engine ignores it.
             session_id=str(body.get("user") or ""),
+            # QoS tier (body `priority` / x-priority header; unknown ->
+            # standard) and tenant identity (the same OpenAI `user` key
+            # the router reads for affinity, x-tenant-id overriding).
+            priority=normalize_tier(body.get("priority")
+                                    or headers.get("x-priority")),
+            tenant_id=str(headers.get("x-tenant-id")
+                          or body.get("user") or ""),
         )
 
     async def _events(self, req):
@@ -193,6 +227,21 @@ class OpenAIServer:
         fleet_health = getattr(self.llm, "fleet_health", None)
         payload["fleet"] = (fleet_health() if callable(fleet_health)
                             else {"enabled": False, "replicas": {}})
+        # QoS — always present (enabled false, zeroed counters when the
+        # knobs are off): engine-side weighted-fair scheduling +
+        # preemption state and the edge's per-tier shed/depth view.
+        edge = self.edge.snapshot()
+        payload["qos"] = {
+            "enabled": bool(getattr(ecfg, "qos", False)) if ecfg else False,
+            "edge_enabled": self.edge.enabled,
+            "preemptions": (self.llm.metrics.qos_preemptions
+                            if self.llm is not None
+                            and hasattr(self.llm.metrics,
+                                        "qos_preemptions") else 0),
+            "shed": {k: v for k, v in edge.items()
+                     if k.startswith("qos_shed_")},
+            "edge_depth": edge["qos_edge_depth"],
+        }
         return web.json_response(payload)
 
     async def handle_models(self, request: web.Request) -> web.Response:
@@ -211,6 +260,11 @@ class OpenAIServer:
         snap = await loop.run_in_executor(
             self._executor,
             lambda: self.llm.metrics.snapshot() if self.llm else {})
+        # Edge shed/depth counters ride the same scrape (always
+        # present — zeros when shedding is off), so one /metrics pull
+        # reads the whole QoS picture: engine tier depths + preemption
+        # count from the engine snapshot, shedding from the edge.
+        snap.update(self.edge.snapshot())
         return web.json_response(snap)
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
@@ -223,9 +277,29 @@ class OpenAIServer:
         if self.llm is None:
             return web.json_response({"error": "no LLM engine"}, status=503)
         body = await request.json()
-        req = self._gen_request(body, chat)
+        req = self._gen_request(body, chat, request.headers)
         if not req.session_id:
             req.session_id = request.headers.get("x-session-id", "")
+        # Edge admission: shed past the tier's in-flight bound with
+        # 429 + Retry-After BEFORE the engine sees the request —
+        # overload must cost the caller one RTT, not an unbounded
+        # queue wait (serving/qos.py EdgeAdmission).
+        retry_after = self.edge.try_admit(req.priority)
+        if retry_after is not None:
+            return web.json_response(
+                {"error": {"message": f"{req.priority}-tier queue is "
+                           "full; retry later",
+                           "type": "rate_limit_exceeded",
+                           "code": "tier_queue_full"}},
+                status=429,
+                headers={"Retry-After": str(max(1, round(retry_after)))})
+        try:
+            return await self._generate_admitted(request, body, req, chat)
+        finally:
+            self.edge.release(req.priority)
+
+    async def _generate_admitted(self, request: web.Request, body: Dict,
+                                 req, chat: bool) -> web.StreamResponse:
         stops = self._stop_strings(body)
         stream = bool(body.get("stream"))
         from generativeaiexamples_tpu.serving.engine import PromptTooLongError

@@ -58,6 +58,8 @@ from collections import deque
 from typing import Dict, List, Sequence
 
 from generativeaiexamples_tpu.serving.prefix_cache import RadixTree
+from generativeaiexamples_tpu.serving.qos import (
+    TIER_LOAD_WEIGHT, normalize_tier)
 
 # A stable-hash choice this many queued requests deeper than the
 # shallowest admitting replica falls back to least-loaded.
@@ -121,6 +123,10 @@ class ReplicaState:
         # undelivered token budget (the in-flight token load signal).
         self.inflight = 0
         self.pending_tokens = 0
+        # Per-tier split of `inflight` (serving/qos.py tiers): the
+        # locality score weighs queued latency-tier requests heavier
+        # than batch backlog — tier pressure, not just raw depth.
+        self.inflight_tier: Dict[str, int] = {}
         # No real prefix cache on the replica -> the router feeds the
         # shadow itself at placement time.
         self.self_feed = self_feed
@@ -199,11 +205,14 @@ class PrefixLocalityRouter:
 
     # -- load accounting (fleet stream hooks) ------------------------------
 
-    def note_submitted(self, rid: str, est_tokens: int) -> None:
+    def note_submitted(self, rid: str, est_tokens: int,
+                       tier: str = "standard") -> None:
         with self._lock:
             st = self._replicas[rid]
             st.inflight += 1
             st.pending_tokens += est_tokens
+            tier = normalize_tier(tier)
+            st.inflight_tier[tier] = st.inflight_tier.get(tier, 0) + 1
 
     def note_progress(self, rid: str, tokens: int) -> None:
         with self._lock:
@@ -211,13 +220,17 @@ class PrefixLocalityRouter:
             if st is not None:
                 st.pending_tokens = max(0, st.pending_tokens - tokens)
 
-    def note_finished(self, rid: str, leftover_tokens: int) -> None:
+    def note_finished(self, rid: str, leftover_tokens: int,
+                      tier: str = "standard") -> None:
         with self._lock:
             st = self._replicas.get(rid)
             if st is not None:
                 st.inflight = max(0, st.inflight - 1)
                 st.pending_tokens = max(0, st.pending_tokens
                                         - leftover_tokens)
+                tier = normalize_tier(tier)
+                st.inflight_tier[tier] = max(
+                    0, st.inflight_tier.get(tier, 0) - 1)
 
     def note_evicted(self, rid: str) -> None:
         with self._lock:
@@ -230,6 +243,13 @@ class PrefixLocalityRouter:
     def queue_depths(self) -> Dict[str, int]:
         with self._lock:
             return {rid: st.inflight for rid, st in self._replicas.items()}
+
+    def tier_queue_depths(self) -> Dict[str, Dict[str, int]]:
+        """Per-replica, per-tier in-flight depth (the exported tier-
+        pressure signal behind _score's weighting)."""
+        with self._lock:
+            return {rid: dict(st.inflight_tier)
+                    for rid, st in self._replicas.items()}
 
     # -- placement (the fleet dispatch hot path) ---------------------------
 
@@ -249,9 +269,22 @@ class PrefixLocalityRouter:
         held. Score units are tokens: cached-prefix tokens this replica
         would skip, minus a queue-depth penalty — locality wins until
         the owning replica is deep enough that prefilling elsewhere is
-        cheaper."""
+        cheaper. Depth is TIER-WEIGHTED (serving/qos.py
+        TIER_LOAD_WEIGHT): queued latency-tier requests repel new
+        placements harder than batch backlog; all-standard traffic
+        weighs exactly like the raw count, so tier-less deployments
+        score byte-identically."""
         matched = st.shadow.match_tokens(ids)
-        return (matched - self.load_penalty_tokens * st.inflight, matched)
+        return (matched - self.load_penalty_tokens
+                * self._tier_pressure(st), matched)
+
+    def _tier_pressure(self, st: ReplicaState) -> int:
+        """Lock held. Tier-weighted queue depth; falls back to the raw
+        count when no per-tier accounting has been reported."""
+        if not st.inflight_tier:
+            return st.inflight
+        return sum(n * TIER_LOAD_WEIGHT.get(t, 1)
+                   for t, n in st.inflight_tier.items())
 
     def place(self, ids: Sequence[int], session: str = "") -> str:  # graftlint: hot-path
         """Pick the replica for a prompt. Raises LookupError when no
@@ -331,4 +364,7 @@ class PrefixLocalityRouter:
                                       for k in ROUTER_COUNTER_KEYS}
             out["router_queue_depth"] = {rid: st.inflight for rid, st in
                                          self._replicas.items()}
+            out["router_tier_depth"] = {rid: dict(st.inflight_tier)
+                                        for rid, st in
+                                        self._replicas.items()}
             return out

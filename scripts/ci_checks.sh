@@ -27,7 +27,12 @@
 #                   forced tiny HBM budget so the pager actually pages,
 #                   promotions observed, live writes race searches,
 #                   tiered ids == plain-IVF ids).
-#   7. tier-1 tests — the ROADMAP.md pytest gate.
+#   7. QoS smoke  — CPU gate for the SLO-aware multi-tenant scheduler
+#                   (scripts/smoke_qos.py: latency-tier goodput beats
+#                   FIFO on a canned bursty trace, batch tier not
+#                   starved, over-bound requests get a fast 429 +
+#                   Retry-After instead of a hang).
+#   8. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -57,6 +62,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "tiered-ANN smoke (JAX_PLATFORMS=cpu scripts/smoke_tiered_ann.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_tiered_ann.py || fail=1
+
+    step "QoS smoke (JAX_PLATFORMS=cpu scripts/smoke_qos.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_qos.py || fail=1
 
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -329,6 +329,22 @@ class TestHostSync:
         findings = lint_paths([write_tree(tmp_path, {"mod.py": HOT_CLEAN})])
         assert ids_of(findings) == set()
 
+    def test_qos_scheduler_functions_are_hot(self, tmp_path):
+        # The QoS tier-selection/preemption path (PR 9) is in the
+        # HOT_DEFAULTS set: a host sync in the weighted-fair pop or the
+        # preemption refresh stalls every tier at once. Seeded
+        # violations in both engine.py and qos.py must fire unmarked.
+        for i, (fname, fn) in enumerate((
+                ("engine.py", "_qos_pop_waiting"),
+                ("engine.py", "_qos_refresh_preemption"),
+                ("qos.py", "pick"),
+                ("qos.py", "try_admit"))):
+            src = HOT_BAD.replace(
+                "def _step(self):  # graftlint: hot-path",
+                f"def {fn}(self):")
+            root = write_tree(tmp_path / f"case{i}", {fname: src})
+            assert "GL401" in ids_of(lint_paths([root])), (fname, fn)
+
 
 class TestConfigDrift:
     def test_fires_on_all_three_drift_shapes(self, tmp_path):
