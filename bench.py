@@ -137,12 +137,14 @@ Sibling tooling (same checkout):
       the fleet scenario as a standalone CPU tool (multi-replica
       aggregate throughput + router hit-rate)
   python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/
-      graftlint static analysis (trace purity, lock discipline, thread
-      hygiene, host-sync, config drift; docs/static_analysis.md) —
-      also via scripts/lint.py [--ruff]
+      graftlint static analysis (trace purity, lock discipline +
+      cross-thread races, thread hygiene, call-graph-inferred hot-path
+      host-sync, atomic persistence, metrics contract, config drift;
+      docs/static_analysis.md) — also via scripts/lint.py [--ruff |
+      --changed], with --explain-hot-path <func> for the hot-set chain
   scripts/ci_checks.sh
-      the full check pipeline: graftlint + ruff + config-docs drift +
-      tier-1 pytest
+      the full check pipeline: graftlint (+ SARIF artifact, stale-
+      baseline gate) + ruff + config-docs drift + tier-1 pytest
 """
 
 from __future__ import annotations

@@ -132,6 +132,9 @@ def run_eval(llm, embedder, dataset: Sequence[Dict],
 
 
 def save_report(report: Dict, path: str) -> None:
+    from generativeaiexamples_tpu.utils.fsio import atomic_write_text
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as fh:
-        json.dump(report, fh, indent=2)
+    # tmp + os.replace (GL502): a crash mid-dump must not truncate a
+    # report a previous run already wrote.
+    atomic_write_text(path, json.dumps(report, indent=2))

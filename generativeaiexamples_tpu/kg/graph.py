@@ -114,10 +114,14 @@ class EntityGraph:
     # -- persistence -------------------------------------------------------
 
     def save(self, path: str) -> None:
+        from generativeaiexamples_tpu.utils.fsio import atomic_write_text
+
         with self._lock:
             rows = [dataclasses.asdict(t) for t in self._triples]
-        with open(path, "w") as fh:
-            json.dump({"triples": rows}, fh)
+        # Persisted under vector_store.persist_dir (knowledge_graph.json)
+        # — written via tmp + os.replace so a crash mid-dump can't leave
+        # a truncated graph for the next load (GL502 idiom).
+        atomic_write_text(path, json.dumps({"triples": rows}))
 
     @classmethod
     def load(cls, path: str) -> "EntityGraph":

@@ -11,9 +11,15 @@ AST-based pass that makes them visible:
 
 - ``python -m generativeaiexamples_tpu.lint <paths>`` (or
   ``scripts/lint.py``) runs every check; exit 0 = clean, 1 = findings,
-  2 = usage error.
+  2 = usage error. ``--changed`` scopes to git-diffed files + their
+  reverse call-graph dependents; ``--explain-hot-path <func>`` prints
+  the root->func chain behind the inferred hot set; ``--format
+  sarif`` feeds CI code annotations.
 - Checks are plugins under ``lint/checks/`` (see
-  ``docs/static_analysis.md`` for the catalog and how to add one).
+  ``docs/static_analysis.md`` for the catalog and how to add one);
+  interprocedural rules (hot-path inference, cross-thread races, the
+  metrics contract) share one project call graph (``callgraph.py``:
+  self-dispatch, imports, attribute dataflow, thread spawns).
 - Justified findings live in the checked-in ``lint-baseline.json``
   (content-hash keyed, so line drift and file moves don't invalidate
   suppressions), each with a human reason string.

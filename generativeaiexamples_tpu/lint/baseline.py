@@ -107,7 +107,10 @@ class Baseline:
         return cls(entries)
 
     def save(self, path: str) -> None:
+        from generativeaiexamples_tpu.utils.fsio import atomic_write_text
+
         payload = {"version": 1, "entries": self.entries}
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(payload, fh, indent=2, sort_keys=False)
-            fh.write("\n")
+        # Own idiom, dogfooded (GL502): the checked-in baseline is a
+        # persisted artifact too — never truncate it in place.
+        atomic_write_text(
+            path, json.dumps(payload, indent=2, sort_keys=False) + "\n")

@@ -6,17 +6,28 @@ from generativeaiexamples_tpu.lint.checks.trace_purity import \
     TracePurityCheck
 from generativeaiexamples_tpu.lint.checks.lock_discipline import \
     LockDisciplineCheck
+from generativeaiexamples_tpu.lint.checks.cross_thread import \
+    CrossThreadRaceCheck
 from generativeaiexamples_tpu.lint.checks.thread_hygiene import (
     ThreadDaemonCheck, ThreadSwallowCheck)
-from generativeaiexamples_tpu.lint.checks.host_sync import HostSyncCheck
+from generativeaiexamples_tpu.lint.checks.host_sync import (
+    HostSyncCheck, HostSyncInferredCheck)
 from generativeaiexamples_tpu.lint.checks.config_drift import \
     ConfigDriftCheck
+from generativeaiexamples_tpu.lint.checks.persistence import \
+    AtomicPersistenceCheck
+from generativeaiexamples_tpu.lint.checks.metrics_contract import \
+    MetricsContractCheck
 
 ALL_CHECKS = [
     TracePurityCheck,
     LockDisciplineCheck,
+    CrossThreadRaceCheck,
     ThreadDaemonCheck,
     ThreadSwallowCheck,
     HostSyncCheck,
+    HostSyncInferredCheck,
     ConfigDriftCheck,
+    AtomicPersistenceCheck,
+    MetricsContractCheck,
 ]

@@ -65,6 +65,16 @@ def _static_names(keywords: Iterable[ast.keyword]) -> Set[str]:
     return names
 
 
+def unwrap_partial(node: ast.AST) -> ast.AST:
+    """`functools.partial(f, ...)` -> `f` (recursively); anything else
+    unchanged. Lets thread targets / callbacks written as partials
+    resolve to the underlying function reference."""
+    while isinstance(node, ast.Call) and \
+            last_part(dotted(node.func)) == "partial" and node.args:
+        node = node.args[0]
+    return node
+
+
 def param_names(fn) -> List[str]:
     """Positional + kw-only parameter names (self/cls dropped)."""
     a = fn.args

@@ -1,4 +1,4 @@
-"""GL50x config-drift: the schema, the generated docs, and string-keyed
+"""GL501/GL505/GL506 config-drift: the schema, the generated docs, and string-keyed
 knob references must agree.
 
 The config tree (`config/schema.py`) is the single source of truth;
@@ -8,10 +8,10 @@ reference resolves against it. Three drift shapes:
 - GL501 — a schema field missing from docs/configuration.md: someone
   added a knob and skipped `scripts/gen_config_docs.py`, so deployers
   can't discover it.
-- GL502 — `getattr(cfg, "…")` with a string key that resolves to no
+- GL505 — `getattr(cfg, "…")` with a string key that resolves to no
   schema section or field: a renamed/removed knob still referenced by
   name, which `getattr(..., default)` silently papers over.
-- GL503 — an `APP_<SECTION>_<FIELD>` env-var literal that matches no
+- GL506 — an `APP_<SECTION>_<FIELD>` env-var literal that matches no
   schema field's computed env name: deploy files would set it and
   nothing would read it.
 
@@ -160,7 +160,7 @@ class ConfigDriftCheck(Check):
                 return i
         return 1
 
-    # -- GL502: string-keyed getattr ---------------------------------------
+    # -- GL505: string-keyed getattr ---------------------------------------
 
     def _check_getattrs(self, sf: SourceFile, model: SchemaModel,
                         known: Set[str]) -> Iterable[Finding]:
@@ -178,7 +178,7 @@ class ConfigDriftCheck(Check):
                 continue
             if key.value not in known:
                 yield Finding(
-                    check="GL502", name=self.name, severity=self.severity,
+                    check="GL505", name=self.name, severity=self.severity,
                     path=sf.rel, line=node.lineno,
                     message=(f'getattr(..., "{key.value}") resolves to no '
                              f"config section or schema field; the knob "
@@ -196,7 +196,7 @@ class ConfigDriftCheck(Check):
             return node.attr == "config"
         return False
 
-    # -- GL503: env-var literals -------------------------------------------
+    # -- GL506: env-var literals -------------------------------------------
 
     def _check_env_literals(self, sf: SourceFile,
                             model: SchemaModel) -> Iterable[Finding]:
@@ -209,7 +209,7 @@ class ConfigDriftCheck(Check):
                 continue
             if v not in model.env_names:
                 yield Finding(
-                    check="GL503", name=self.name, severity=self.severity,
+                    check="GL506", name=self.name, severity=self.severity,
                     path=sf.rel, line=node.lineno,
                     message=(f'env-var literal "{v}" matches no schema '
                              f"field's APP_<SECTION>_<FIELD> name; "

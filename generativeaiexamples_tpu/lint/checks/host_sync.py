@@ -1,5 +1,5 @@
-"""GL401 host-sync-in-hot-path: blocking device->host syncs on the
-engine step loop / batcher dispatch path.
+"""GL401/GL402 host-sync-in-hot-path: blocking device->host syncs on
+the serving dispatch paths.
 
 The serving engine's throughput hinges on the scheduler thread never
 blocking on the device: dispatches are async, and the ONLY sanctioned
@@ -9,10 +9,22 @@ compute; see engine.py `_loop`). A stray `block_until_ready`,
 serializes the pipeline and silently halves tokens/sec — no test
 fails, the benchmark just gets slower.
 
-Scope: functions are "hot" when (a) they are the known step-loop /
-dispatch functions of `serving/engine.py` and `serving/batcher.py`, or
-(b) their `def` line carries a `# graftlint: hot-path` marker (how new
-hot paths opt in). Flagged inside a hot function:
+Hot scope comes in two layers:
+
+- **GL401 declared hot paths** — the ROOT functions of each serving
+  dispatch loop (`HOT_ROOTS` below) plus any function whose `def` line
+  carries a `# graftlint: hot-path` marker. This is the hand-curated
+  layer: small, stable, and the seed of the inference.
+- **GL402 inferred hot paths** — everything REACHABLE from those roots
+  through the project call graph (lint/callgraph.py: `self.method()`
+  dispatch, intra-package calls, attribute dataflow). Through PR 9 the
+  equivalent set was a hand-maintained per-function dict that every PR
+  had to extend; now a helper pulled onto the dispatch path is hot the
+  moment the call edge exists, and each finding carries the root→func
+  call chain so it is self-justifying (`--explain-hot-path <func>`
+  prints the same chain).
+
+Flagged inside a hot function (either layer):
 
 - `.block_until_ready(...)` / `jax.block_until_ready(...)`
 - `jax.device_get(...)`
@@ -26,47 +38,93 @@ from __future__ import annotations
 import ast
 import os
 import re
-from typing import Iterable, Set
+from typing import Dict, Iterable, Optional, Set
 
 from generativeaiexamples_tpu.lint.core import Check, Finding, Project, \
     SourceFile
+from generativeaiexamples_tpu.lint import callgraph
 from generativeaiexamples_tpu.lint.checks import _util as u
 
 HOT_PATH_MARK = re.compile(r"#\s*graftlint:\s*hot-path")
-# Known hot functions per module basename: the engine scheduler beat
-# and the micro-batcher dispatcher. Extend via the marker comment.
-HOT_DEFAULTS = {
-    # The StepPlan dispatch path (engine.py PR-6 refactor): plan
-    # selection + the single plan_step lowering replaced the old
-    # per-lane _dispatch_decode_spec/_dispatch_fused_rider functions.
-    # The QoS admission/preemption path (serving/qos.py policy layer):
-    # tier selection runs inside _admit_waiting under the waiting
-    # lock, preemption refresh runs once per scheduler beat — a host
-    # sync in either stalls every tier, which defeats the point of
-    # having tiers.
-    "engine.py": {"_loop", "_admit_waiting", "_dispatch_decode",
-                  "_select_plan", "_dispatch_plan", "_rider_candidate",
-                  "_advance_long_prefills", "_emit_ready_first_tokens",
-                  "_qos_pop_waiting", "_qos_refresh_preemption",
-                  "_qos_latency_pressure"},
-    "batcher.py": {"_loop", "_run", "_take_group"},
-    # QoS policy layer (serving/qos.py): pick/note_admitted run under
-    # the engine's waiting lock on the scheduler thread, try_admit on
-    # every server request thread.
-    "qos.py": {"pick", "note_admitted", "try_admit"},
-    # The fleet request path (serving/router.py + serving/fleet.py):
-    # placement and the per-event stream hook run on server request /
-    # engine scheduler threads — a host sync there stalls every
-    # replica's dispatch, not just one engine's.
-    "router.py": {"place", "_choose", "_score", "_apply_reports"},
-    "fleet.py": {"submit", "_on_event"},
-    # The tiered-ANN search side (ops/tiered.py): one device dispatch
-    # plus host-side miss refine/merge per logical search — a stray
-    # sync here serializes every retrieval caller behind the pager.
-    "tiered.py": {"search", "_host_refine", "_merge"},
+# Declared hot-path ROOTS per module basename: the entry function of
+# each serving dispatch loop. Everything call-graph-reachable from
+# these is hot (GL402); new subsystems add ONE root (or a `# graftlint:
+# hot-path` marker on their entry) instead of enumerating every helper.
+HOT_ROOTS: Dict[str, Set[str]] = {
+    "engine.py": {"_loop"},      # scheduler beat: admission, plans, emits
+    "batcher.py": {"_run"},      # micro-batch dispatch (loop is marked)
+    "router.py": {"place"},      # fleet placement, server request threads
+    "fleet.py": {"submit"},      # fleet dispatch + stream hooks
+    "qos.py": {"pick"},          # weighted-fair pop under the waiting lock
+    "tiered.py": {"search"},     # tiered-ANN dispatch + host refine/merge
 }
 DEVICE_NAME_RE = re.compile(r"(^|_)dev(_|$)|device", re.IGNORECASE)
 NUMPY_MODULES = ("np", "numpy", "onp")
+
+
+def declared_hot(sf: SourceFile, fn) -> bool:
+    """True when `fn` is a GL401 declared hot path: a HOT_ROOTS entry
+    for this module, or marked `# graftlint: hot-path` on (or right
+    above) its def line."""
+    base = os.path.basename(sf.path)
+    if fn.name in HOT_ROOTS.get(base, ()):
+        return True
+    for lineno in (fn.lineno, fn.lineno - 1):
+        if HOT_PATH_MARK.search(sf.line(lineno)):
+            return True
+    return False
+
+
+def hot_root_keys(graph: "callgraph.CallGraph") -> Set[str]:
+    """Call-graph keys of every declared hot path (roots + markers)."""
+    keys = graph.keys_for(HOT_ROOTS)
+    for key, node in graph.nodes.items():
+        for lineno in (node.node.lineno, node.node.lineno - 1):
+            if HOT_PATH_MARK.search(node.sf.line(lineno)):
+                keys.add(key)
+                break
+    return keys
+
+
+def inferred_hot(graph: "callgraph.CallGraph") -> Dict[str, Optional[str]]:
+    """{hot function key: call-graph parent key} — every function
+    reachable from the declared roots over CALL edges (spawn edges
+    start a different thread and do not propagate hotness)."""
+    return graph.reachable(sorted(hot_root_keys(graph)))
+
+
+def _scan_syncs(sf: SourceFile, fn) -> Iterable:
+    """Yield (lineno, message) for every host-sync shape in `fn`."""
+    for node in u.walk_stop_at_functions(fn, include_root=False):
+        if not isinstance(node, ast.Call):
+            continue
+        name = u.dotted(node.func)
+        last = u.last_part(name)
+        if last == "block_until_ready":
+            yield node.lineno, (
+                "block_until_ready on the hot path stalls the "
+                "dispatch pipeline; fetch on the reader thread / "
+                "overlap with device compute instead")
+        elif last == "device_get":
+            yield node.lineno, (
+                "jax.device_get on the hot path is a synchronous "
+                "device->host round trip; defer the fetch or hand "
+                "it to the reader thread")
+        elif last in ("asarray", "array") and name \
+                and name.split(".")[0] in NUMPY_MODULES \
+                and node.args and _looks_device(node.args[0]):
+            yield node.lineno, (
+                f"{name}() of a device value on the hot path is an "
+                f"implicit blocking transfer; copy_to_host_async + "
+                f"drain later, or move it off this thread")
+
+
+def _looks_device(arg: ast.AST) -> bool:
+    if u.self_attr_target(arg) is not None:
+        return True
+    if isinstance(arg, ast.Name) and DEVICE_NAME_RE.search(arg.id):
+        return True
+    return False
 
 
 class HostSyncCheck(Check):
@@ -74,59 +132,45 @@ class HostSyncCheck(Check):
     name = "host-sync-hot-path"
     severity = "warning"
     describe = ("block_until_ready / device_get / implicit np. "
-                "conversion inside the engine step loop or batcher "
-                "dispatch path")
+                "conversion inside a declared hot path (HOT_ROOTS "
+                "entry or `# graftlint: hot-path` marker)")
 
     def run(self, project: Project) -> Iterable[Finding]:
         for sf in project.files:
             if sf.tree is None:
                 continue
-            base = os.path.basename(sf.path)
-            defaults: Set[str] = HOT_DEFAULTS.get(base, set())
             for fn in u.iter_functions(sf.tree):
-                if not self._is_hot(sf, fn, defaults):
+                if not declared_hot(sf, fn):
                     continue
-                yield from self._scan(sf, fn)
+                for lineno, msg in _scan_syncs(sf, fn):
+                    yield self.finding(sf, lineno, msg)
 
-    def _is_hot(self, sf: SourceFile, fn, defaults: Set[str]) -> bool:
-        if fn.name in defaults:
-            return True
-        # marker on the def line or the line above it
-        for lineno in (fn.lineno, fn.lineno - 1):
-            if HOT_PATH_MARK.search(sf.line(lineno)):
-                return True
-        return False
 
-    def _scan(self, sf: SourceFile, fn) -> Iterable[Finding]:
-        for node in u.walk_stop_at_functions(fn, include_root=False):
-            if not isinstance(node, ast.Call):
+class HostSyncInferredCheck(Check):
+    id = "GL402"
+    name = "host-sync-inferred"
+    severity = "warning"
+    describe = ("host sync in a function call-graph-reachable from a "
+                "hot-path root (engine._loop, batcher._run, "
+                "router.place, fleet.submit, qos.pick, tiered.search)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        graph = callgraph.build(project)
+        parent = inferred_hot(graph)
+        roots = hot_root_keys(graph)
+        for key in sorted(parent):
+            if key in roots:
+                continue  # declared layer: GL401 already scans it
+            node = graph.nodes[key]
+            syncs = list(_scan_syncs(node.sf, node.node))
+            if not syncs:
                 continue
-            name = u.dotted(node.func)
-            last = u.last_part(name)
-            if last == "block_until_ready":
+            chain = graph.chain(parent, key)
+            via = " -> ".join(
+                f"{graph.nodes[k].module}:{graph.nodes[k].qual}"
+                for k in chain)
+            for lineno, msg in syncs:
                 yield self.finding(
-                    sf, node.lineno,
-                    "block_until_ready on the hot path stalls the "
-                    "dispatch pipeline; fetch on the reader thread / "
-                    "overlap with device compute instead")
-            elif last == "device_get":
-                yield self.finding(
-                    sf, node.lineno,
-                    "jax.device_get on the hot path is a synchronous "
-                    "device->host round trip; defer the fetch or hand "
-                    "it to the reader thread")
-            elif last in ("asarray", "array") and name \
-                    and name.split(".")[0] in NUMPY_MODULES \
-                    and node.args and self._looks_device(node.args[0]):
-                yield self.finding(
-                    sf, node.lineno,
-                    f"{name}() of a device value on the hot path is an "
-                    f"implicit blocking transfer; copy_to_host_async + "
-                    f"drain later, or move it off this thread")
-
-    def _looks_device(self, arg: ast.AST) -> bool:
-        if u.self_attr_target(arg) is not None:
-            return True
-        if isinstance(arg, ast.Name) and DEVICE_NAME_RE.search(arg.id):
-            return True
-        return False
+                    node.sf, lineno,
+                    f"{msg} [hot via {via}; `--explain-hot-path "
+                    f"{node.name}` reprints this chain]")

@@ -507,10 +507,12 @@ class TieredIVFIndex:
 
     # -- demand pager / compaction (single-flight background) --------------
 
-    def maintenance_due(self) -> bool:
+    def maintenance_due(self) -> bool:  # graftlint: ignore[GL202]
         """Cheap, lock-free peek (racy reads of ints are fine — worst
-        case one extra no-op kick): compaction or a pager rebalance is
-        warranted."""
+        case one extra no-op kick, and kick_maintenance re-checks
+        single-flight under the lock): compaction or a pager rebalance
+        is warranted. The lock-free reads are the point, hence the
+        GL202 suppression."""
         if self._mnt_busy:
             return False
         if self._tail_rows_total > max(COMPACT_MIN_ROWS,
@@ -565,8 +567,10 @@ class TieredIVFIndex:
     def run_maintenance(self) -> None:
         """One synchronous maintenance pass (tests call this directly;
         kick_maintenance runs it on the single-flight worker)."""
-        if self._tail_rows_total > max(COMPACT_MIN_ROWS,
-                                       COMPACT_TAIL_FRAC * self.n_rows):
+        with self._lock:
+            compact = self._tail_rows_total > max(
+                COMPACT_MIN_ROWS, COMPACT_TAIL_FRAC * self.n_rows)
+        if compact:
             self._compact()
         self._rebalance()
 
@@ -582,7 +586,10 @@ class TieredIVFIndex:
             consumed = {p: len(chunks) for p, chunks in self._tails.items()}
             tails = {p: list(self._tails[p][:n])
                      for p, n in consumed.items()}
-        new_lens = self._base_lens.copy()
+            # Part of the same snapshot: a concurrent install mutates
+            # _base_lens under the lock, and an off-lock copy here
+            # could pair stale lengths with the fresh offsets above.
+            new_lens = self._base_lens.copy()
         for p, chunks in tails.items():
             new_lens[p] += sum(len(r) for r, _ in chunks)
         new_off = np.concatenate([[0], np.cumsum(new_lens)]).astype(np.int64)

@@ -819,11 +819,13 @@ class TPUVectorStore(MemoryVectorStore):
         return state
 
     def _dump_ivf_state(self, path: str, state: Dict) -> None:
-        """The one ivf.npz writer (atomic, serialized): the lock-held
-        save() path, the deferred search-path writer, and the
-        background trainer all go through it, so the sidecar format
-        cannot fork and concurrent writers cannot clobber each other's
-        fixed-name tmp file."""
+        """The one ivf.npz writer (atomic, serialized): save(), the
+        deferred search-path writer, and the background trainer all go
+        through it, so the sidecar format cannot fork and concurrent
+        writers cannot clobber each other's fixed-name tmp file.
+        Serialization comes from _sidecar_lock below — callers need no
+        lock of their own (GL202 verifies docstring conventions against
+        call sites now, so this docstring must not claim one)."""
         with self._sidecar_lock:
             os.makedirs(path, exist_ok=True)
 
@@ -835,10 +837,10 @@ class TPUVectorStore(MemoryVectorStore):
 
     def _write_sidecar(self, state: Dict) -> None:
         """Persist IVF training state (no lock needed: `state` is a
-        snapshot; np.savez_compressed of a large assignments array is
-        too slow for the lock-held search path). A racing
-        mutation-save may remove/replace the file — benign, the loader
-        validates row counts."""
+        snapshot, and np.savez_compressed of a large assignments array
+        is too slow to run while the search path keeps the store lock).
+        A racing mutation-save may remove/replace the file — benign,
+        the loader validates row counts."""
         if not self.persist_dir:
             return
         self._dump_ivf_state(self.persist_dir, state)
@@ -995,6 +997,11 @@ class TPUVectorStore(MemoryVectorStore):
     # -- observability -----------------------------------------------------
 
     def stats(self) -> Dict:
+        # _bg_errors is guarded by _slow_lock (all three writers run on
+        # background threads under it); read it under the SAME lock —
+        # taken before, never inside, the store lock.
+        with self._slow_lock:
+            bg_errors = self._bg_errors
         with self._lock:
             out = super().stats()
             live = "ivf" if self._ivf is not None else "flat"
@@ -1010,7 +1017,7 @@ class TPUVectorStore(MemoryVectorStore):
                 "ann_recall_est": (round(self._recall_sum / self._recall_n, 4)
                                    if self._recall_n else None),
                 "index_rebuilds": self._rebuilds,
-                "background_errors": self._bg_errors,
+                "background_errors": bg_errors,
                 "tiered": self.tiered,
             })
             ivf = self._ivf

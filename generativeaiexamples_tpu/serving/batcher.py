@@ -187,6 +187,9 @@ class MicroBatcher:
 
     # -- dispatcher thread -------------------------------------------------
 
+    # The dispatcher thread's beat: everything it calls (_take_group,
+    # _run and the fn cores behind it) is hot by call-graph inference.
+    # graftlint: hot-path
     def _loop(self) -> None:
         while True:
             with self._cond:

@@ -516,6 +516,8 @@ class EngineFleet:
 
     # -- stream hook (engine scheduler/pacer threads) ----------------------
 
+    # Rides every engine scheduler/pacer emission via _TrackedStream.put.
+    # graftlint: hot-path
     def _on_event(self, rec: _ReqRecord, ev: Dict[str, Any]) -> None:
         rec.started = True
         if ev.get("token_id", -1) >= 0:

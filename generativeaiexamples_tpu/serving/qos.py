@@ -142,6 +142,8 @@ class TierScheduler:
                        str(getattr(waiting[i], "tenant_id", "") or ""), 0),
                        i))
 
+    # Runs under the engine's waiting lock on the scheduler thread.
+    # graftlint: hot-path
     def note_admitted(self, req) -> None:
         """Charge one admission's estimated tokens to its tier+tenant."""
         est = max(1, len(getattr(req, "prompt_ids", []) or [])
@@ -176,6 +178,8 @@ class EdgeAdmission:
         self._depth = {t: 0 for t in TIERS}
         self._shed = {t: 0 for t in TIERS}
 
+    # Runs on every server request thread before engine submit.
+    # graftlint: hot-path
     def try_admit(self, tier: str) -> Optional[float]:
         """None = admitted (caller MUST release()); a float = shed,
         the Retry-After hint in seconds."""

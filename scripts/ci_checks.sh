@@ -7,8 +7,14 @@
 #
 # Steps:
 #   1. graftlint  — JAX-serving-aware static analysis (trace purity,
-#                   lock discipline, thread hygiene, host-sync, config
-#                   drift); zero non-baselined findings required.
+#                   lock discipline + cross-thread races, thread
+#                   hygiene, call-graph-inferred hot-path host-sync,
+#                   atomic persistence, metrics contract, config
+#                   drift); zero non-baselined findings required, and
+#                   STALE baseline entries (fixed code) fail the step
+#                   (--fail-stale) so the baseline shrinks over time.
+#                   A SARIF artifact lands at build/lint.sarif for CI
+#                   code-annotation upload.
 #   2. ruff       — generic pycodestyle/pyflakes/bugbear subset
 #                   (pyproject.toml [tool.ruff]); skipped with a notice
 #                   when ruff isn't installed in the image.
@@ -41,7 +47,18 @@ fail=0
 step() { echo; echo "== $* =="; }
 
 step "graftlint (python -m generativeaiexamples_tpu.lint)"
-python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/ || fail=1
+# ONE pass: the gate (zero non-baselined findings + no stale baseline
+# entries) and the SARIF annotation artifact come from the same run.
+mkdir -p build
+python -m generativeaiexamples_tpu.lint generativeaiexamples_tpu/ \
+    --fail-stale --sarif-out build/lint.sarif || fail=1
+if [ -s build/lint.sarif ]; then
+    echo "wrote build/lint.sarif ($(wc -c < build/lint.sarif) bytes) — \
+CI uploads this for inline code annotations"
+else
+    echo "build/lint.sarif missing/empty (lint crashed before emitting?)"
+    fail=1
+fi
 
 step "ruff (scripts/lint.py --ruff; skips when absent)"
 if command -v ruff >/dev/null 2>&1; then

@@ -3,14 +3,20 @@
 
     python scripts/lint.py               # graftlint over the package
     python scripts/lint.py --ruff        # ... plus ruff, when installed
+    python scripts/lint.py --changed     # diff-scoped pre-commit run
     python scripts/lint.py path/ --select GL201   # args forwarded
+    python scripts/lint.py --explain-hot-path _prefill_group
 
 graftlint (generativeaiexamples_tpu/lint/) is the JAX-serving-aware
-pass: trace purity, lock discipline, thread hygiene, host-sync,
-config drift — see docs/static_analysis.md. ruff covers the generic
-pycodestyle/pyflakes/bugbear subset configured in pyproject.toml; the
-container doesn't ship it, so `--ruff` skips gracefully (exit 0 for
-that step) when it is not importable/runnable.
+pass: trace purity, lock discipline + cross-thread races, thread
+hygiene, call-graph-inferred hot-path host-sync, atomic persistence,
+metrics contract, config drift — see docs/static_analysis.md.
+`--changed` parses the whole package (cross-file checks stay sound)
+but reports only findings in git-changed files AND their reverse
+call-graph dependents — the fast pre-commit loop. ruff covers the
+generic pycodestyle/pyflakes/bugbear subset configured in
+pyproject.toml; the container doesn't ship it, so `--ruff` skips
+gracefully (exit 0 for that step) when it is not importable/runnable.
 
 Exit code: nonzero when any executed step found problems (graftlint's
 0/1/2 contract is preserved when ruff is skipped or clean).
@@ -41,7 +47,8 @@ def run_ruff(paths) -> int:
 
 
 VALUE_FLAGS = {"--select", "--ignore", "--baseline", "--write-baseline",
-               "--min-severity", "--format"}
+               "--min-severity", "--format", "--explain-hot-path",
+               "--sarif-out"}
 
 
 def positional_paths(args):

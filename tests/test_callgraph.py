@@ -1,0 +1,329 @@
+"""Unit tests for lint/callgraph.py: the interprocedural layer behind
+GL402 (hot-path inference), GL202 (cross-thread races), GL601 (metrics
+contract) and the CLI's --explain-hot-path / --changed.
+
+Pure AST work — no jax, runs in milliseconds.
+"""
+
+import os
+import textwrap
+
+from generativeaiexamples_tpu.lint import callgraph
+from generativeaiexamples_tpu.lint.core import load_project
+
+
+def build(root, files):
+    for rel, src in files.items():
+        path = os.path.join(str(root), rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(textwrap.dedent(src))
+    return callgraph.build(load_project([str(root)]))
+
+
+def node_named(graph, qual):
+    hits = [n for n in graph.nodes.values() if n.qual == qual]
+    assert len(hits) == 1, (qual, [n.key for n in hits])
+    return hits[0]
+
+
+def callees_of(graph, qual):
+    n = node_named(graph, qual)
+    return {graph.nodes[k].qual for k in graph.calls.get(n.key, ())}
+
+
+def spawns_of(graph, qual):
+    n = node_named(graph, qual)
+    return {graph.nodes[k].qual for k in graph.spawns.get(n.key, ())}
+
+
+class TestResolution:
+    def test_self_dispatch_and_module_functions(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            def helper():
+                return 1
+
+
+            class Engine:
+                def _loop(self):
+                    self._dispatch()
+                    helper()
+
+                def _dispatch(self):
+                    return 2
+        """})
+        assert callees_of(g, "Engine._loop") == {"Engine._dispatch",
+                                                 "helper"}
+
+    def test_base_class_method_resolution(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            class Base:
+                def shared(self):
+                    return 1
+
+
+            class Child(Base):
+                def go(self):
+                    return self.shared()
+        """})
+        assert callees_of(g, "Child.go") == {"Base.shared"}
+
+    def test_intra_package_import_resolution(self, tmp_path):
+        g = build(tmp_path, {
+            "pkg/util.py": "def tool():\n    return 1\n",
+            "pkg/app.py": """\
+                from pkg.util import tool
+                from pkg import util
+
+
+                def use():
+                    tool()
+                    util.tool()
+            """,
+        })
+        assert callees_of(g, "use") == {"tool"}
+
+    def test_attribute_dataflow_constructor(self, tmp_path):
+        # self.metrics = Metrics() makes self.metrics.note() resolve.
+        g = build(tmp_path, {"m.py": """\
+            class Metrics:
+                def note(self):
+                    return 1
+
+
+            class Engine:
+                def __init__(self):
+                    self.metrics = Metrics()
+
+                def step(self):
+                    self.metrics.note()
+        """})
+        assert callees_of(g, "Engine.step") == {"Metrics.note"}
+
+    def test_attribute_dataflow_param_annotation(self, tmp_path):
+        # The fleet shape: self._fleet = fleet with a string annotation.
+        g = build(tmp_path, {"m.py": """\
+            class Fleet:
+                def on_event(self):
+                    return 1
+
+
+            class Stream:
+                def __init__(self, fleet: "Fleet"):
+                    self._fleet = fleet
+
+                def put(self, item):
+                    self._fleet.on_event()
+        """})
+        assert callees_of(g, "Stream.put") == {"Fleet.on_event"}
+
+    def test_cross_module_attribute_class(self, tmp_path):
+        g = build(tmp_path, {
+            "pkg/qos.py": """\
+                class TierScheduler:
+                    def pick(self, waiting):
+                        return 0
+            """,
+            "pkg/engine.py": """\
+                from pkg.qos import TierScheduler
+
+
+                class Engine:
+                    def __init__(self):
+                        self.qos = TierScheduler()
+
+                    def _pop(self):
+                        return self.qos.pick([])
+            """,
+        })
+        assert callees_of(g, "Engine._pop") == {"TierScheduler.pick"}
+
+    def test_decorated_functions_resolve_by_name(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            import functools
+
+
+            def deco(fn):
+                return fn
+
+
+            @deco
+            def worker():
+                return 1
+
+
+            def run():
+                worker()
+        """})
+        assert "worker" in callees_of(g, "run")
+
+    def test_nested_def_called_by_parent(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            def outer():
+                def inner():
+                    return 1
+                return inner()
+        """})
+        assert callees_of(g, "outer") == {"outer.<locals>.inner"}
+
+    def test_callback_reference_argument(self, tmp_path):
+        # _atomic_replace(path, write_fn): the reference creates a call
+        # edge (the callee invokes it synchronously).
+        g = build(tmp_path, {"m.py": """\
+            def atomic(path, write_fn):
+                write_fn(path)
+
+
+            class Store:
+                def save(self, path):
+                    def write(tmp):
+                        return tmp
+                    atomic(path, write)
+        """})
+        assert callees_of(g, "Store.save") == {
+            "atomic", "Store.save.<locals>.write"}
+
+
+class TestThreadEntries:
+    def test_thread_target_is_spawn_not_call(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            import threading
+
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._work,
+                                     daemon=True).start()
+
+                def _work(self):
+                    return 1
+        """})
+        assert spawns_of(g, "W.start") == {"W._work"}
+        assert "W._work" not in callees_of(g, "W.start")
+
+    def test_executor_submit_is_spawn(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            class W:
+                def go(self, pool):
+                    pool.submit(self._task, 1)
+
+                def _task(self, x):
+                    return x
+        """})
+        assert spawns_of(g, "W.go") == {"W._task"}
+
+    def test_engine_submit_request_is_not_spawn(self, tmp_path):
+        # .submit(req) with a non-callable first arg stays a plain
+        # (unresolved) call — no bogus thread entry.
+        g = build(tmp_path, {"m.py": """\
+            class Fleet:
+                def route(self, replica, req):
+                    replica.submit(req)
+        """})
+        assert spawns_of(g, "Fleet.route") == set()
+
+    def test_partial_thread_target_unwraps(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            import functools
+            import threading
+
+
+            class W:
+                def start(self):
+                    threading.Thread(
+                        target=functools.partial(self._work, 1)).start()
+
+                def _work(self, n):
+                    return n
+        """})
+        assert spawns_of(g, "W.start") == {"W._work"}
+
+    def test_nested_def_thread_target(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            import threading
+
+
+            class W:
+                def kick(self):
+                    def run():
+                        return 1
+                    threading.Thread(target=run, daemon=True).start()
+        """})
+        assert spawns_of(g, "W.kick") == {"W.kick.<locals>.run"}
+
+
+class TestReachability:
+    FILES = {"m.py": """\
+        class E:
+            def _loop(self):
+                self._a()
+
+            def _a(self):
+                self._b()
+
+            def _b(self):
+                return 1
+
+            def cold(self):
+                return 2
+    """}
+
+    def test_reachable_and_chain(self, tmp_path):
+        g = build(tmp_path, self.FILES)
+        root = node_named(g, "E._loop")
+        parent = g.reachable([root.key])
+        quals = {g.nodes[k].qual for k in parent}
+        assert quals == {"E._loop", "E._a", "E._b"}
+        target = node_named(g, "E._b")
+        chain = [g.nodes[k].qual for k in g.chain(parent, target.key)]
+        assert chain == ["E._loop", "E._a", "E._b"]
+
+    def test_spawn_edges_do_not_propagate_by_default(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            import threading
+
+
+            class E:
+                def _loop(self):
+                    threading.Thread(target=self._bg).start()
+
+                def _bg(self):
+                    return 1
+        """})
+        root = node_named(g, "E._loop")
+        assert {g.nodes[k].qual for k in g.reachable([root.key])} == \
+            {"E._loop"}
+        followed = g.reachable([root.key], follow_spawns=True)
+        assert {g.nodes[k].qual for k in followed} == {"E._loop", "E._bg"}
+
+
+class TestDependents:
+    def test_reverse_file_dependents(self, tmp_path):
+        g = build(tmp_path, {
+            "pkg/helper.py": "def tool():\n    return 1\n",
+            "pkg/caller.py": """\
+                from pkg.helper import tool
+
+
+                def use():
+                    return tool()
+            """,
+            "pkg/loner.py": "def alone():\n    return 2\n",
+        })
+        helper_rel = node_named(g, "tool").sf.rel
+        deps = g.dependent_files({helper_rel})
+        assert deps == {node_named(g, "use").sf.rel}
+
+    def test_functions_named_specs(self, tmp_path):
+        g = build(tmp_path, {"pkg/engine.py": """\
+            class E:
+                def step(self):
+                    return 1
+
+
+            def step():
+                return 2
+        """})
+        assert len(g.functions_named("step")) == 2
+        assert [n.qual for n in g.functions_named("E.step")] == ["E.step"]
+        assert len(g.functions_named("engine.py:step")) == 2

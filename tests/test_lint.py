@@ -197,6 +197,212 @@ HOT_CLEAN = """\
             return self._dispatch()  # async dispatch only
 """
 
+# GL402: the sync lives in a helper the root reaches only through the
+# call graph (self-dispatch + a module-level function) — per-function
+# scanning (the pre-inference GL401) cannot see it.
+INFER_BAD = """\
+    import jax
+
+
+    def fetch_stats(arr):
+        return jax.device_get(arr)
+
+
+    class Engine:
+        def _loop(self):
+            while True:
+                self._dispatch()
+
+        def _dispatch(self):
+            jax.block_until_ready(self._tokens)  # helper, not a root
+            return fetch_stats(self._tokens)
+"""
+
+INFER_CLEAN = """\
+    import jax
+
+
+    def fetch_stats(arr):
+        return jax.device_get(arr)  # never called from a hot root
+
+
+    class Engine:
+        def _loop(self):
+            while True:
+                self._dispatch()
+
+        def _dispatch(self):
+            return self._issue()  # async; syncs stay off this path
+
+        def _issue(self):
+            return 1
+
+        def debug_dump(self):
+            return fetch_stats(self._tokens)  # cold path: fine
+"""
+
+# GL202: the worker thread writes _n under the lock, the public surface
+# reads it bare — no common lock on any call path. The clean twin locks
+# the public read; _peek shows call-site-verified lock inheritance (it
+# is ONLY called under the lock, so its read counts as locked).
+RACE_BAD = """\
+    import threading
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def start(self):
+            threading.Thread(target=self._work, daemon=True).start()
+
+        def _work(self):
+            with self._lock:
+                self._n += 1
+
+        def progress(self):
+            return self._n  # bare read racing the worker's writes
+"""
+
+RACE_CLEAN = """\
+    import threading
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def start(self):
+            threading.Thread(target=self._work, daemon=True).start()
+
+        def _work(self):
+            with self._lock:
+                self._n += 1
+
+        def progress(self):
+            with self._lock:
+                return self._peek()
+
+        def _peek(self):
+            return self._n  # called only under the lock: locked
+"""
+
+# GL202's docstring verification: 'Lock held' is a checked claim now.
+DOCSTRING_BAD = """\
+    import threading
+
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._v = 0
+
+        def set(self, v):
+            self._store(v)  # lock-free call into a 'Lock held' method
+
+        def locked_set(self, v):
+            with self._lock:
+                self._store(v)
+
+        def _store(self, v):
+            \"\"\"Lock held.\"\"\"
+            self._v = v
+"""
+
+# GL601: `dropped` is incremented but snapshot() never surfaces it;
+# `lost` is incremented on a resolved instance attribute from another
+# class. The clean twin surfaces both (one via a rename-read, one as a
+# literal key).
+METRICS_BAD = """\
+    class Stats:
+        def __init__(self):
+            self.served = 0
+            self.dropped = 0
+            self.lost = 0
+
+        def note(self):
+            self.served += 1
+            self.dropped += 1
+
+        def snapshot(self):
+            return {"served": self.served}
+
+
+    class Owner:
+        def __init__(self):
+            self.stats = Stats()
+
+        def fail(self):
+            self.stats.lost += 1
+"""
+
+METRICS_CLEAN = """\
+    class Stats:
+        def __init__(self):
+            self.served = 0
+            self.dropped = 0
+            self.lost = 0
+
+        def note(self):
+            self.served += 1
+            self.dropped += 1
+
+        def snapshot(self):
+            return {"served": self.served,
+                    "requests_dropped": self.dropped,  # rename-read
+                    "lost": self.lost}
+
+
+    class Owner:
+        def __init__(self):
+            self.stats = Stats()
+
+        def fail(self):
+            self.stats.lost += 1
+"""
+
+# GL502: save() rewrites the artifact in place; the clean twin stages
+# through a tmp name and os.replace()s it into place. `_write_rows` is
+# only a sink because its CALLER provably works under persist_dir.
+PERSIST_BAD = """\
+    import json
+    import os
+
+
+    def _write_rows(rows, path):
+        with open(path, "w") as fh:
+            json.dump(rows, fh)
+
+
+    class Store:
+        def save(self, path):
+            with open(path, "w") as fh:
+                json.dump(self._rows, fh)
+
+        def persist(self):
+            _write_rows(self._rows,
+                        os.path.join(self.persist_dir, "rows.json"))
+"""
+
+PERSIST_CLEAN = """\
+    import json
+    import os
+
+
+    class Store:
+        def save(self, path):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(self._rows, fh)
+            os.replace(tmp, path)
+
+        def export_debug(self, path):
+            with open(path, "w") as fh:  # not a persisted artifact
+                json.dump(self._rows, fh)
+"""
+
 CONFIG_SCHEMA = """\
     from dataclasses import dataclass, field
 
@@ -317,11 +523,11 @@ class TestHostSync:
         gl401 = [f for f in findings if f.check == "GL401"]
         assert len(gl401) == 3  # block_until_ready + device_get + asarray
 
-    def test_engine_module_defaults_apply(self, tmp_path):
-        # In a file named engine.py the known scheduler functions are
-        # hot without any marker.
+    def test_engine_root_applies_without_marker(self, tmp_path):
+        # In a file named engine.py the scheduler root `_loop` is hot
+        # with no marker (HOT_ROOTS).
         src = HOT_BAD.replace("def _step(self):  # graftlint: hot-path",
-                              "def _dispatch_decode(self):")
+                              "def _loop(self):")
         findings = lint_paths([write_tree(tmp_path, {"engine.py": src})])
         assert "GL401" in ids_of(findings)
 
@@ -329,21 +535,159 @@ class TestHostSync:
         findings = lint_paths([write_tree(tmp_path, {"mod.py": HOT_CLEAN})])
         assert ids_of(findings) == set()
 
-    def test_qos_scheduler_functions_are_hot(self, tmp_path):
-        # The QoS tier-selection/preemption path (PR 9) is in the
-        # HOT_DEFAULTS set: a host sync in the weighted-fair pop or the
-        # preemption refresh stalls every tier at once. Seeded
-        # violations in both engine.py and qos.py must fire unmarked.
+    def test_all_declared_roots_apply(self, tmp_path):
+        # One root per serving dispatch loop (the whole HOT_ROOTS
+        # surface): a sync in any of them fires with no marker.
         for i, (fname, fn) in enumerate((
-                ("engine.py", "_qos_pop_waiting"),
-                ("engine.py", "_qos_refresh_preemption"),
-                ("qos.py", "pick"),
-                ("qos.py", "try_admit"))):
+                ("engine.py", "_loop"), ("batcher.py", "_run"),
+                ("router.py", "place"), ("fleet.py", "submit"),
+                ("qos.py", "pick"), ("tiered.py", "search"))):
             src = HOT_BAD.replace(
                 "def _step(self):  # graftlint: hot-path",
                 f"def {fn}(self):")
             root = write_tree(tmp_path / f"case{i}", {fname: src})
             assert "GL401" in ids_of(lint_paths([root])), (fname, fn)
+
+
+class TestHotPathInference:
+    def test_fires_through_the_call_graph(self, tmp_path):
+        # The syncs sit in a self-dispatched helper and a module-level
+        # function — reachable from engine._loop only via call edges.
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"engine.py": INFER_BAD})])
+        gl402 = [f for f in findings if f.check == "GL402"]
+        assert len(gl402) == 2
+        msgs = " ".join(f.message for f in gl402)
+        assert "hot via" in msgs            # self-justifying chain
+        assert "engine.py:Engine._loop" in msgs
+
+    def test_quiet_off_the_hot_graph(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"engine.py": INFER_CLEAN})])
+        assert ids_of(findings) == set()
+
+    def test_inferred_set_is_superset_of_pre_pr_hot_defaults(self):
+        # Pin: the call-graph-inferred hot set must cover every entry
+        # of the hand-maintained HOT_DEFAULTS dict this PR deleted
+        # (lint/checks/host_sync.py:38 as of PR 9) — for EVERY module.
+        # A regression here means a dispatch-path helper silently left
+        # the scanned set.
+        from generativeaiexamples_tpu.lint import callgraph
+        from generativeaiexamples_tpu.lint.checks import host_sync
+        from generativeaiexamples_tpu.lint.core import load_project
+
+        pre_pr_hot_defaults = {
+            "engine.py": {"_loop", "_admit_waiting", "_dispatch_decode",
+                          "_select_plan", "_dispatch_plan",
+                          "_rider_candidate", "_advance_long_prefills",
+                          "_emit_ready_first_tokens", "_qos_pop_waiting",
+                          "_qos_refresh_preemption",
+                          "_qos_latency_pressure"},
+            "batcher.py": {"_loop", "_run", "_take_group"},
+            "qos.py": {"pick", "note_admitted", "try_admit"},
+            "router.py": {"place", "_choose", "_score", "_apply_reports"},
+            "fleet.py": {"submit", "_on_event"},
+            "tiered.py": {"search", "_host_refine", "_merge"},
+        }
+        project = load_project([PKG])
+        graph = callgraph.build(project)
+        hot = host_sync.inferred_hot(graph)
+        by_mod = {}
+        for key in hot:
+            node = graph.nodes[key]
+            by_mod.setdefault(node.module, set()).add(node.name)
+        for mod, fns in pre_pr_hot_defaults.items():
+            missing = fns - by_mod.get(mod, set())
+            assert not missing, (mod, missing)
+        # STRICT superset: inference reaches helpers the dict never
+        # listed (e.g. the prefill group path under _admit_waiting).
+        assert "_prefill_group" in by_mod["engine.py"]
+        total_old = sum(len(v) for v in pre_pr_hot_defaults.values())
+        assert len(hot) > total_old
+
+
+class TestCrossThreadRace:
+    def test_fires_on_unlocked_public_read(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": RACE_BAD})])
+        gl202 = [f for f in findings if f.check == "GL202"]
+        assert len(gl202) == 1
+        assert "_n" in gl202[0].message
+        assert "progress" in gl202[0].message
+
+    def test_quiet_when_callsite_verified_locked(self, tmp_path):
+        # progress() locks; _peek is invoked ONLY from under the lock,
+        # so its read counts as locked without any docstring.
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": RACE_CLEAN})])
+        assert ids_of(findings) == set()
+
+    def test_lock_held_docstring_is_verified(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": DOCSTRING_BAD})])
+        gl202 = [f for f in findings if f.check == "GL202"]
+        assert len(gl202) == 1
+        assert "Lock held" in gl202[0].message
+        assert "set" in gl202[0].message  # the violating caller, named
+
+    def test_docstring_clean_when_all_callsites_locked(self, tmp_path):
+        src = DOCSTRING_BAD.replace(
+            "        def set(self, v):\n"
+            "            self._store(v)  # lock-free call into a "
+            "'Lock held' method\n",
+            "        def set(self, v):\n"
+            "            with self._lock:\n"
+            "                self._store(v)\n")
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": src})])
+        assert ids_of(findings) == set()
+
+
+class TestMetricsContract:
+    def test_fires_on_unsurfaced_counters(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": METRICS_BAD})])
+        gl601 = [f for f in findings if f.check == "GL601"]
+        assert len(gl601) == 2
+        msgs = " ".join(f.message for f in gl601)
+        assert "dropped" in msgs      # internal increment
+        assert "lost" in msgs         # external, via attr dataflow
+        assert "served" not in msgs   # surfaced: read by snapshot()
+
+    def test_quiet_when_surfaced(self, tmp_path):
+        # `dropped` is surfaced under a RENAMED key (the read is what
+        # counts), `lost` as a literal key.
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": METRICS_CLEAN})])
+        assert ids_of(findings) == set()
+
+    def test_functional_state_exempt(self, tmp_path):
+        # An incremented attr the class itself consumes (a cursor) is
+        # state, not a lost counter.
+        src = METRICS_BAD.replace(
+            "        def snapshot(self):",
+            "        def spin(self):\n"
+            "            return self.dropped % 3\n\n"
+            "        def snapshot(self):")
+        findings = lint_paths([write_tree(tmp_path, {"mod.py": src})])
+        assert all("dropped" not in f.message for f in findings
+                   if f.check == "GL601")
+
+
+class TestAtomicPersistence:
+    def test_fires_on_in_place_writes(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": PERSIST_BAD})])
+        gl502 = [f for f in findings if f.check == "GL502"]
+        # Store.save (name-scoped) + _write_rows (reverse-call-chain
+        # taint through the persist_dir-handling caller).
+        assert len(gl502) == 2
+        msgs = " ".join(f.message for f in gl502)
+        assert "Store.save" in msgs
+        assert "persist_dir" in msgs
+
+    def test_quiet_on_tmp_replace_idiom(self, tmp_path):
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": PERSIST_CLEAN})])
+        assert ids_of(findings) == set()
 
 
 class TestConfigDrift:
@@ -354,11 +698,13 @@ class TestConfigDrift:
             "docs/configuration.md": CONFIG_DOCS_MISSING_BETA,
         })
         findings = lint_paths([root])
-        assert {"GL501", "GL502", "GL503"} <= ids_of(findings)
+        # GL505/GL506 (renamed from GL502/GL503 when GL502 became the
+        # atomic-persistence check): same three drift shapes.
+        assert {"GL501", "GL505", "GL506"} <= ids_of(findings)
         by = {f.check: f for f in findings}
         assert "foo.beta" in by["GL501"].message
-        assert "gamma" in by["GL502"].message
-        assert "APP_FOO_NOPE" in by["GL503"].message
+        assert "gamma" in by["GL505"].message
+        assert "APP_FOO_NOPE" in by["GL506"].message
 
     def test_quiet_when_in_sync(self, tmp_path):
         root = write_tree(tmp_path, {
@@ -519,18 +865,33 @@ class TestCLI:
     @pytest.mark.parametrize("check_id,files", [
         ("GL101", {"mod.py": TRACE_BAD}),
         ("GL201", {"mod.py": LOCK_BAD}),
+        ("GL202", {"mod.py": RACE_BAD}),
         ("GL301", {"mod.py": THREAD_BAD}),
         ("GL302", {"mod.py": THREAD_BAD}),
         ("GL401", {"mod.py": HOT_BAD}),
+        ("GL402", {"engine.py": INFER_BAD}),
         ("GL501", {"pkg/config/schema.py": CONFIG_SCHEMA,
                    "pkg/app.py": CONFIG_APP_BAD,
                    "docs/configuration.md": CONFIG_DOCS_MISSING_BETA}),
+        ("GL502", {"mod.py": PERSIST_BAD}),
+        ("GL601", {"mod.py": METRICS_BAD}),
     ])
     def test_exit_1_per_seeded_fixture(self, tmp_path, check_id, files):
         root = write_tree(tmp_path, files)
         proc = run_cli(root, "--no-baseline")
         assert proc.returncode == 1, proc.stdout + proc.stderr
         assert check_id in proc.stdout
+
+    @pytest.mark.parametrize("files", [
+        {"engine.py": INFER_CLEAN},
+        {"mod.py": RACE_CLEAN},
+        {"mod.py": METRICS_CLEAN},
+        {"mod.py": PERSIST_CLEAN},
+    ])
+    def test_exit_0_per_clean_counterpart(self, tmp_path, files):
+        root = write_tree(tmp_path, files)
+        proc = run_cli(root, "--no-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
 
     def test_exit_2_on_bad_flag(self):
         assert run_cli("--definitely-not-a-flag").returncode == 2
@@ -552,7 +913,8 @@ class TestCLI:
     def test_list_checks(self):
         proc = run_cli("--list-checks")
         assert proc.returncode == 0
-        for cid in ("GL101", "GL201", "GL301", "GL302", "GL401", "GL501"):
+        for cid in ("GL101", "GL201", "GL202", "GL301", "GL302", "GL401",
+                    "GL402", "GL501", "GL502", "GL601"):
             assert cid in proc.stdout
 
     def test_json_format(self, tmp_path):
@@ -570,6 +932,165 @@ class TestCLI:
         proc = run_cli(root, "--baseline", bl_path)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "1 baselined" in proc.stdout
+
+    def test_explain_hot_path_prints_chain(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": INFER_BAD})
+        proc = run_cli(root, "--explain-hot-path", "fetch_stats")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # root -> helper -> function, in order, marked as a chain
+        assert "is HOT" in proc.stdout
+        assert proc.stdout.index("Engine._loop") \
+            < proc.stdout.index("Engine._dispatch") \
+            < proc.stdout.rindex("fetch_stats")
+        assert "(root)" in proc.stdout
+
+    def test_explain_hot_path_cold_function_exits_1(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": INFER_CLEAN})
+        proc = run_cli(root, "--explain-hot-path", "debug_dump")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "not in the inferred hot set" in proc.stdout
+
+    def test_explain_hot_path_unknown_exits_2(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": INFER_CLEAN})
+        proc = run_cli(root, "--explain-hot-path", "no_such_function")
+        assert proc.returncode == 2
+        assert "no function matching" in proc.stderr
+
+    def test_sarif_format(self, tmp_path):
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        proc = run_cli(root, "--no-baseline", "--format", "sarif")
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "graftlint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"GL101", "GL202", "GL402", "GL502", "GL601"} <= rule_ids
+        res = run["results"][0]
+        assert res["ruleId"] == "GL201"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("mod.py")
+        assert loc["region"]["startLine"] > 0
+        assert res["partialFingerprints"]["graftlintContentHash/v1"]
+
+    def test_sarif_out_rides_the_gating_run(self, tmp_path):
+        # --sarif-out writes the artifact in the SAME pass as the text
+        # gate (ci_checks.sh relies on this: one lint run, two outputs).
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        out = str(tmp_path / "lint.sarif")
+        proc = run_cli(root, "--no-baseline", "--sarif-out", out)
+        assert proc.returncode == 1            # text gate still gates
+        assert "GL201" in proc.stdout          # text output intact
+        doc = json.load(open(out))
+        assert doc["runs"][0]["results"][0]["ruleId"] == "GL201"
+
+    def test_changed_rejects_write_baseline(self, tmp_path):
+        # A diff-scoped regenerate would truncate the baseline to the
+        # diff's findings, silently deleting curated entries.
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        proc = run_cli(root, "--changed", "--write-baseline",
+                       str(tmp_path / "bl.json"))
+        assert proc.returncode == 2
+        assert "--write-baseline" in proc.stderr
+
+    def test_fail_stale_exits_nonzero(self, tmp_path):
+        # Baseline an entry, fix the code: --fail-stale turns the
+        # formerly-informational stale report into a gate.
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        bl_path = str(tmp_path / "bl.json")
+        assert run_cli(root, "--write-baseline", bl_path).returncode == 0
+        fixed = write_tree(tmp_path / "fixed", {"mod.py": LOCK_CLEAN})
+        ok = run_cli(fixed, "--baseline", bl_path)
+        assert ok.returncode == 0  # stale is informational by default
+        gated = run_cli(fixed, "--baseline", bl_path, "--fail-stale")
+        assert gated.returncode == 1, gated.stdout + gated.stderr
+        assert "stale baseline entry" in gated.stderr
+
+    def test_fail_stale_ignores_incomplete_runs(self, tmp_path):
+        # A raised severity floor filters findings BEFORE the baseline
+        # sees them; stale accounting must not mistake that for fixed
+        # code (the entry's finding is warning-severity and still
+        # present).
+        root = write_tree(tmp_path, {"mod.py": LOCK_BAD})
+        bl_path = str(tmp_path / "bl.json")
+        assert run_cli(root, "--write-baseline", bl_path).returncode == 0
+        proc = run_cli(root, "--baseline", bl_path, "--fail-stale",
+                       "--min-severity", "error")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestChangedScope:
+    def _git(self, root, *args):
+        return subprocess.run(["git", *args], cwd=root, text=True,
+                              capture_output=True, timeout=60)
+
+    def test_changed_scopes_to_diff_and_dependents(self, tmp_path):
+        # helper.py gains a violation; caller.py (depends via the call
+        # graph) and loner.py (violating but untouched and unrelated)
+        # sit beside it. --changed must report helper's finding and
+        # skip loner's.
+        root = write_tree(tmp_path, {
+            "pkg/helper.py": "def helper():\n    return 1\n",
+            "pkg/caller.py": "from pkg.helper import helper\n\n\n"
+                             "def use():\n    return helper()\n",
+            "pkg/loner.py": LOCK_BAD,
+        })
+        for args in (("init", "-q"), ("add", "-A"),
+                     ("-c", "user.email=t@t", "-c", "user.name=t",
+                      "commit", "-qm", "seed")):
+            proc = self._git(root, *args)
+            assert proc.returncode == 0, proc.stderr
+        # Introduce a violation in helper.py only.
+        with open(os.path.join(root, "pkg", "helper.py"), "w") as fh:
+            fh.write(textwrap.dedent(RACE_BAD))
+        proc = subprocess.run(
+            CLI + [os.path.join(root, "pkg"), "--no-baseline",
+                   "--changed"],
+            cwd=root, text=True, capture_output=True, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "GL202" in proc.stdout          # changed file reported
+        assert "loner.py" not in proc.stdout   # untouched: filtered
+        assert "--changed" in proc.stdout      # scope note printed
+
+    def test_changed_deleted_file_recheck_its_importers(self, tmp_path):
+        # Deleting a module leaves no call-graph nodes to walk back
+        # from; its former importers must still land in scope (their
+        # edges just vanished — exactly when GL402/GL202 conclusions
+        # can change).
+        root = write_tree(tmp_path, {
+            "pkg/helper.py": "def helper():\n    return 1\n",
+            "pkg/caller.py": "from pkg.helper import helper\n\n\n"
+                             + textwrap.dedent(RACE_BAD).replace(
+                                 "class Worker", "class Caller"),
+        })
+        for args in (("init", "-q"), ("add", "-A"),
+                     ("-c", "user.email=t@t", "-c", "user.name=t",
+                      "commit", "-qm", "seed")):
+            proc = self._git(root, *args)
+            assert proc.returncode == 0, proc.stderr
+        os.unlink(os.path.join(root, "pkg", "helper.py"))
+        proc = subprocess.run(
+            CLI + [os.path.join(root, "pkg"), "--no-baseline",
+                   "--changed"],
+            cwd=root, text=True, capture_output=True, timeout=120)
+        # caller.py imported the deleted helper: its GL202 finding is
+        # in scope even though caller.py itself is untouched.
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "caller.py" in proc.stdout
+
+    def test_changed_clean_when_nothing_changed(self, tmp_path):
+        root = write_tree(tmp_path, {"pkg/loner.py": LOCK_BAD})
+        for args in (("init", "-q"), ("add", "-A"),
+                     ("-c", "user.email=t@t", "-c", "user.name=t",
+                      "commit", "-qm", "seed")):
+            proc = self._git(root, *args)
+            assert proc.returncode == 0, proc.stderr
+        proc = subprocess.run(
+            CLI + [os.path.join(root, "pkg"), "--no-baseline",
+                   "--changed"],
+            cwd=root, text=True, capture_output=True, timeout=120)
+        # loner.py's finding exists but is out of scope: exit 0.
+        assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 # ---------------------------------------------------------------------------

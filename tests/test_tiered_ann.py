@@ -372,10 +372,12 @@ class TestTieredStore:
 
 
 class TestLintCoverage:
-    def test_gl401_covers_tiered_search_side(self, tmp_path):
-        """GL401's hot-path defaults must include ops/tiered.py's
-        search-side functions: a seeded block_until_ready inside
-        search() is flagged with no marker comment."""
+    def test_hot_path_covers_tiered_search_side(self, tmp_path):
+        """tiered.py's search side stays in the host-sync scan with no
+        marker comment: `search` is a declared HOT_ROOTS entry (GL401),
+        and the helpers it calls — `_host_refine`/`_merge` in the real
+        module — are hot by call-graph inference (GL402, which replaced
+        the per-function HOT_DEFAULTS dict in PR 10)."""
         from generativeaiexamples_tpu.lint import lint_paths
 
         bad = textwrap.dedent("""
@@ -385,21 +387,25 @@ class TestLintCoverage:
             def search(self, q):
                 out = self._dispatch(q)
                 out.block_until_ready()
-                return out
+                return self._host_refine(out)
 
             def _host_refine(self, q):
                 return jax.device_get(q)
         """)
         mod = tmp_path / "tiered.py"
         mod.write_text(bad)
-        findings = [f for f in lint_paths([str(mod)])
-                    if f.check == "GL401"]
-        assert len(findings) == 2
-        # ... and the shipped module itself is clean.
+        findings = lint_paths([str(mod)])
+        gl401 = [f for f in findings if f.check == "GL401"]
+        assert len(gl401) == 1          # the root itself
+        gl402 = [f for f in findings if f.check == "GL402"]
+        assert len(gl402) == 1          # reached from the root
+        assert "search" in gl402[0].message  # chain is self-justifying
+        # ... and the shipped module itself is clean on both layers.
         src = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "generativeaiexamples_tpu",
             "ops", "tiered.py")
-        assert not [f for f in lint_paths([src]) if f.check == "GL401"]
+        assert not [f for f in lint_paths([src])
+                    if f.check in ("GL401", "GL402")]
 
     def test_gl201_covers_tier_state_lock(self, tmp_path):
         """GL201 must treat the tier-state lock like any engine lock: a
