@@ -68,6 +68,18 @@ Scenario output keys (under "extras"):
                  prefix_hit_tokens (warm-prefix vs cold TTFT through
                  serving/prefix_cache.py — the RAG repeated-prefix
                  serving shape; BENCH_PREFIX=0 skips)
+  KV tiering:    kv_sessions_resident_vs_hbm_only,
+                 kv_warm_resume_ttft_ms, kv_cold_resume_ttft_ms,
+                 kv_promote_ms_per_page, kv_sessions, kv_demotions,
+                 kv_promotions, kv_host_pages, kv_spill_pages
+                 (session KV pager, serving/kv_pager.py:
+                 BENCH_KV_SESSIONS distinct 2k-prompt sessions served
+                 through a pool sized for ~2, prefix pages demoted
+                 HBM -> host RAM -> disk with the radix tree as the
+                 pager's index; warm resume TTFT = promote matched
+                 pages back with one scatter + a 1-token suffix
+                 forward, vs a cold full prefill; promote ms/page from
+                 a standalone pager microbench. BENCH_KV_TIER=0 skips)
   encoders:      embed_docs_per_sec, embed_queries_per_sec,
                  rerank_pairs_per_sec
   ANN retrieval: ann_search_qps, ann_vs_flat_speedup, ann_recall_at_4,
@@ -131,7 +143,7 @@ Scenario output keys (under "extras"):
 Sibling tooling (same checkout):
   scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_tiered_ann.py /
   smoke_microbatch.py / smoke_fused_step.py / smoke_plan_step.py /
-  smoke_router.py
+  smoke_router.py / smoke_kv_pager.py
       targeted CPU smoke gates for the serving subsystems
   scripts/bench_fleet.py
       the fleet scenario as a standalone CPU tool (multi-replica
@@ -483,6 +495,21 @@ def main() -> None:
         except Exception as e:
             prefix_stats = {"prefix_error": f"{type(e).__name__}: {e}"}
 
+    # -- session KV pager (ISSUE 11 tentpole — the millions-of-
+    # sessions memory story): sessions beyond the device pool's
+    # capacity park in host RAM / disk via serving/kv_pager.py; warm
+    # resume must promote pages back instead of re-prefilling.
+    kv_tier_stats = {}
+    if os.environ.get("BENCH_KV_TIER", "1") != "0":
+        import gc
+
+        eng = None
+        gc.collect()
+        try:
+            kv_tier_stats = _bench_kv_pager(params, cfg)
+        except Exception as e:
+            kv_tier_stats = {"kv_tier_error": f"{type(e).__name__}: {e}"}
+
     # -- embedding + rerank engines (BASELINE.md north star #3: embed
     # QPS for the arctic-embed-l geometry; VERDICT r2 missing #1 — the
     # encoders existed for two rounds with no TPU number). Runs after
@@ -604,6 +631,7 @@ def main() -> None:
             **longctx_stats,
             **fused_stats,
             **prefix_stats,
+            **kv_tier_stats,
             **encoder_stats,
             **ann_stats,
             **tiered_stats,
@@ -860,6 +888,118 @@ def _bench_prefix_cache(params, cfg):
         "prefix_hits": snap["prefix_hits"],
         "prefix_miss": snap["prefix_miss"],
         "prefix_hit_tokens": snap["prefix_hit_tokens"],
+    }
+
+
+def _bench_kv_pager(params, cfg):
+    """Session KV tiering (serving/kv_pager.py): BENCH_KV_SESSIONS
+    distinct 2k-prompt sessions served through a page pool sized for
+    ~2 of them, so the pager must park the rest in host RAM / disk.
+    Reports how many sessions stay resident vs what HBM alone holds,
+    warm-resume TTFT (promote + 1-token suffix forward) vs a cold
+    full prefill, and promote ms/page from a standalone pager
+    microbench (demote a 16-page prefix to host, time the batched
+    promotion scatter back)."""
+    import gc
+
+    from generativeaiexamples_tpu.config.schema import EngineConfig
+    from generativeaiexamples_tpu.serving.engine import LLMEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    gc.collect()
+    if cfg.max_seq_len < 4096 or cfg.vocab_size < 1024:
+        return {"kv_tier_skipped":
+                f"model geometry too small (max_seq_len={cfg.max_seq_len})"}
+    n_sessions = int(os.environ.get("BENCH_KV_SESSIONS", "8"))
+    plen = int(os.environ.get("BENCH_KV_PROMPT", "2048"))
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=4096, page_size=128,
+                        prefill_buckets=(1024,), kv_dtype="int8",
+                        decode_steps_per_dispatch=8, pipeline_depth=2,
+                        prefix_cache=True, prefix_cache_capacity=0.6,
+                        kv_pager=True,
+                        kv_host_budget_mb=int(os.environ.get(
+                            "BENCH_KV_HOST_MB", "2048")))
+    # Pool sized for ~2 sessions' prefixes beyond the active slots:
+    # 2 slots x 32 pages + ~2 x (plen/128) cached.
+    pages_per_session = plen // 128
+    n_pages = 2 * 32 + 2 * pages_per_session + 2
+    eng = LLMEngine(params, cfg, ByteTokenizer(), ecfg, n_pages=n_pages)
+    t0 = time.perf_counter()
+    eng.warmup(long_prompts=True, long_prompt_lengths=(plen,))
+    eng.start()
+    print(f"[bench] kv-pager warmup {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+
+    def ttft(prompt):
+        # Consumes the WHOLE stream: the radix tree is scheduler-
+        # thread-owned, and the resident-count/match reads below must
+        # not race a request still decoding.
+        t0 = time.perf_counter()
+        first = None
+        for ev in eng.generate_stream(prompt, max_new_tokens=2):
+            if first is None and ev["token_id"] >= 0:
+                first = time.perf_counter() - t0
+        if first is None:
+            raise RuntimeError(
+                "kv-pager bench stream ended without a token")
+        return first
+
+    prompts = [[2 + ((i * 31 + s * 7) % 1000) for i in range(plen)]
+               for s in range(n_sessions + 1)]
+    for p in prompts[:n_sessions]:
+        ttft(p)  # serve every session once (cold prefills, demotions)
+    resident = sum(
+        len(eng.prefix_cache.match_nodes(p)) >= pages_per_session - 1
+        for p in prompts[:n_sessions])
+    hbm_sessions = max(1, eng.prefix_cache.capacity_pages
+                       // pages_per_session)
+    cold = ttft(prompts[n_sessions])  # never-seen prompt: full prefill
+    warms = sorted(ttft(prompts[s]) for s in range(3))
+    snap = eng.metrics.snapshot()
+    eng.stop()
+    del eng
+    gc.collect()
+
+    # Promote-cost microbench: a standalone pager over a small pool —
+    # demote a 16-page prefix to host, time the batched promote back.
+    from generativeaiexamples_tpu.serving.kv_cache import (
+        PageAllocator, PagePool)
+    from generativeaiexamples_tpu.serving.kv_pager import (
+        KVPager, PagedPrefixCache)
+
+    state = {}
+    state["pool"] = PagePool.zeros(cfg, 40, 128, dtype="int8")
+    alloc = PageAllocator(40)
+    pager = KVPager(state["pool"], host_budget_mb=512)
+    cache = PagedPrefixCache(alloc, 128, 100, pager,
+                             lambda: state["pool"])
+    ids = list(range(16 * 128))
+    pages = alloc.alloc(16)
+    cache.insert(ids, pages)
+    alloc.release(pages)
+    promote_s = []
+    for _ in range(3):
+        demoted = cache.evict(16)  # not in an assert: -O must not skip it
+        if demoted != 16:
+            raise RuntimeError(f"microbench demoted {demoted}/16 pages")
+        nodes = cache.match_nodes(ids)
+        t0 = time.perf_counter()
+        state["pool"] = cache.promote(state["pool"], nodes)
+        jax.block_until_ready(state["pool"].kv)
+        promote_s.append(time.perf_counter() - t0)
+    pager.close()
+
+    return {
+        "kv_sessions": n_sessions,
+        "kv_sessions_resident_vs_hbm_only": round(resident / hbm_sessions,
+                                                  2),
+        "kv_warm_resume_ttft_ms": round(warms[1] * 1e3, 1),
+        "kv_cold_resume_ttft_ms": round(cold * 1e3, 1),
+        "kv_promote_ms_per_page": round(min(promote_s) / 16 * 1e3, 3),
+        "kv_demotions": snap["kv_demotions"],
+        "kv_promotions": snap["kv_promotions"],
+        "kv_host_pages": snap["kv_host_pages"],
+        "kv_spill_pages": snap["kv_spill_pages"],
     }
 
 

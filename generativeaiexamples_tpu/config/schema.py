@@ -312,6 +312,23 @@ class EngineConfig:
     # pages (LRU-trimmed beyond this; allocator pressure evicts
     # further — live sequences always win over the cache).
     prefix_cache_capacity: float = 0.5
+    # Session KV pager (serving/kv_pager.py; requires prefix_cache):
+    # tier prefix-cache pages HBM -> budgeted host RAM -> mmap'd disk
+    # spill, with the radix tree as the pager's index. Eviction then
+    # DEMOTES cold sessions' KV instead of destroying it (allocator
+    # pressure parks a paused conversation at ~zero HBM cost) and a
+    # prefix match PROMOTES non-resident pages back into the pool with
+    # one batched scatter — warm-resume TTFT stays a page gather, not
+    # a re-prefill, at session counts far beyond what the pool alone
+    # holds. Off by default — off is byte-identical to the PR-1 cache.
+    kv_pager: bool = False
+    # Host-RAM budget for the warm tier, in MB (0 = no host tier:
+    # demotions go straight to the disk spill).
+    kv_host_budget_mb: int = 256
+    # Directory for the cold tier's spill file ("" = a per-engine temp
+    # dir, removed at shutdown). The file is grown and compacted
+    # crash-safely (temp + os.replace).
+    kv_spill_dir: str = ""
     # SLO-aware multi-tenant QoS (serving/qos.py): requests carry a
     # priority tier (latency | standard | batch — body `priority` field
     # or x-priority header) and a tenant id (OpenAI `user` field /

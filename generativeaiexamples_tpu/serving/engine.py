@@ -296,6 +296,12 @@ class EngineMetrics:
         self.admission_failures = 0
         self.qos_preemptions = 0
         self.qos_queue_depth = {"latency": 0, "standard": 0, "batch": 0}
+        # Session KV pager (serving/kv_pager.py): the pager keeps its
+        # own counters behind the tier lock; the engine installs its
+        # stats() here so every scrape reads live values. None (pager
+        # off) emits zeros for every KV_PAGER_KEYS key — present,
+        # never absent, like the router/QoS counters.
+        self.kv_pager_stats = None
         self.started = time.perf_counter()
         # (timestamp, n_tokens) per decode dispatch for the sliding rate.
         self._token_events: deque = deque(maxlen=8192)
@@ -389,6 +395,15 @@ class EngineMetrics:
         out.update(dict.fromkeys(ROUTER_COUNTER_KEYS, 0))
         out["router_queue_depth"] = {}
         out["router_tier_depth"] = {}
+        # KV-pager counters/gauges (serving/kv_pager.py): one shared
+        # key list, zeros when the pager is off — same always-present
+        # contract as the router block above.
+        from generativeaiexamples_tpu.serving.kv_pager import KV_PAGER_KEYS
+
+        if self.kv_pager_stats is not None:
+            out.update(self.kv_pager_stats())
+        else:
+            out.update(dict.fromkeys(KV_PAGER_KEYS, 0))
         return out
 
 
@@ -484,16 +499,42 @@ class LLMEngine:
         # reclaim hook LRU-evicts cached pages whenever live traffic
         # runs short, so the cache can never starve a sequence.
         self.prefix_cache = None
+        # Session KV pager (serving/kv_pager.py): with engine.kv_pager
+        # the cache's eviction DEMOTES pages HBM -> host RAM -> disk
+        # (the radix tree doubles as the pager's index) and a prefix
+        # match promotes non-resident pages back with one scatter —
+        # paused sessions then cost ~zero HBM. None = the PR-1
+        # destroy-on-evict cache, byte-identical.
+        self.kv_pager = None
+        if self.ecfg.kv_pager and not self.ecfg.prefix_cache:
+            raise ValueError("engine.kv_pager requires engine.prefix_cache "
+                             "(the radix tree is the pager's index)")
         if self.ecfg.prefix_cache:
-            from generativeaiexamples_tpu.serving.prefix_cache import (
-                RadixPrefixCache)
-
             cap = int(max(0.0, self.ecfg.prefix_cache_capacity) * n_pages)
-            self.prefix_cache = RadixPrefixCache(self.allocator, ps, cap)
+            if self.ecfg.kv_pager:
+                from generativeaiexamples_tpu.serving.kv_pager import (
+                    KVPager, PagedPrefixCache)
+
+                self.kv_pager = KVPager(
+                    self.pool,
+                    host_budget_mb=self.ecfg.kv_host_budget_mb,
+                    spill_dir=self.ecfg.kv_spill_dir, put=self._put,
+                    max_batch_pages=self.max_pages)
+                self.prefix_cache = PagedPrefixCache(
+                    self.allocator, ps, cap, self.kv_pager,
+                    lambda: self.pool)
+            else:
+                from generativeaiexamples_tpu.serving.prefix_cache import (
+                    RadixPrefixCache)
+
+                self.prefix_cache = RadixPrefixCache(self.allocator, ps,
+                                                     cap)
             self.allocator.reclaim = self._reclaim_cached_pages
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()
         self.metrics = EngineMetrics()
+        if self.kv_pager is not None:
+            self.metrics.kv_pager_stats = self.kv_pager.stats
         # SLO-aware multi-tenant QoS (serving/qos.py): None = the FIFO
         # admission path, byte-identical to the pre-QoS scheduler. With
         # engine.qos on, admission order comes from the weighted-fair
@@ -995,6 +1036,28 @@ class LLMEngine:
                                            np.int32)),
                         self._put(np.ones((1,), np.int32)),
                         self._put(np.zeros((1,), np.int32)))
+        if self.kv_pager is not None:
+            # KV-pager promote/demote twins compile per power-of-two
+            # batch width (demotion chunks and promotions both pad to
+            # one): a cold gather/scatter compiling on the scheduler
+            # thread mid-reclaim would freeze live streams exactly
+            # when the pool is tightest. All rows point at the page-0
+            # sink, so warmup never touches real KV.
+            kp = self.kv_pager
+            w = 1
+            while True:
+                row = self._put(np.zeros((w,), np.int32))
+                engine_model.pool_to_pages(self.pool, row)
+                codes = self._put(np.zeros((w,) + kp.codes_shape,
+                                           kp.codes_dtype))
+                scales = (self._put(np.zeros((w,) + kp.scales_shape,
+                                             np.float32))
+                          if kp.scales_shape else None)
+                self.pool = engine_model.pages_to_pool(self.pool, codes,
+                                                       scales, row)
+                if w >= self.max_pages:
+                    break
+                w *= 2
         # Rider-only plans (the idle interleaved lane's chunk
         # dispatches) are warmed via the chunk-width loops above; the
         # lattice size is the observability gauge for "how many jitted
@@ -1043,6 +1106,11 @@ class LLMEngine:
                 for ev in entry["buf"]:
                     entry["slot"].req.stream.put(ev)
             self._pace_entries.clear()
+        if self.kv_pager is not None:
+            # Drain the single-flight spill worker and drop the mmap
+            # (a daemon worker mid-write at interpreter exit would
+            # race the spill-dir cleanup).
+            self.kv_pager.close()
 
     # -- public API --------------------------------------------------------
 
@@ -1375,7 +1443,10 @@ class LLMEngine:
                 # match (and skew the LRU) on every admission pass.
                 deferred_long.append(req)
                 continue
-            hit = self._lookup_prefix(ids) \
+            # With the pager on and the scratch lane full, any hit is
+            # about to be discarded below — look up WITHOUT promoting
+            # so the doomed hit never costs a device scatter.
+            hit = self._lookup_prefix(ids, promote=not lane_full) \
                 if self.prefix_cache is not None else None
             demoted = False
             if hit is not None and lane_full:
@@ -1614,7 +1685,8 @@ class LLMEngine:
         if freed:
             self.metrics.prefix_evictions += freed
 
-    def _lookup_prefix(self, ids: List[int]):
+    # graftlint: hot-path
+    def _lookup_prefix(self, ids: List[int], promote: bool = True):
         """Longest cached page-granular prefix of this prompt, capped
         at len(ids) - 1 so at least one suffix token always runs
         through the model (its logits sample the first output token).
@@ -1624,14 +1696,63 @@ class LLMEngine:
         ensure allocations between lookup and the gather can trigger
         reclaim eviction of refcount-1 tree pages, and the sequence
         holds no reference of its own to this one. Every consumer of a
-        hit must release the pin (_release_hit_pin)."""
-        pages = self.prefix_cache.match(ids)
-        if not pages:
-            return None
+        hit must release the pin (_release_hit_pin).
+
+        With engine.kv_pager, the match may land on DEMOTED nodes
+        (host RAM / disk spill): the whole matched path is promoted
+        back into the pool with one batched scatter before the pages
+        are returned — a warm session resume costs a page gather, not
+        a re-prefill. If the allocator cannot cover the cold pages
+        even after reclaim, the hit falls back to the device-resident
+        prefix (the resident set is ancestor-closed, so that is always
+        the leading run)."""
+        from generativeaiexamples_tpu.serving.prefix_cache import (
+            TIER_DEVICE)
+
+        if self.kv_pager is None:
+            pages = self.prefix_cache.match(ids)
+            if not pages:
+                return None
+            nodes = None
+        else:
+            nodes = self.prefix_cache.match_nodes(ids)
+            if not nodes:
+                return None
+            pages = nodes  # length drives the cap below
         ps = self.pool.page_size
         m = min(len(pages) * ps, len(ids) - 1)
         if m <= 0:
             return None
+        if nodes is not None:
+            nodes = nodes[: -(-m // ps)]
+            if any(n.tier != TIER_DEVICE for n in nodes):
+                promoted = False
+                if promote:
+                    try:
+                        self.pool = self.prefix_cache.promote(self.pool,
+                                                              nodes)
+                        promoted = True
+                    except MemoryError:
+                        pass  # resident-prefix fallback below
+                if not promoted:
+                    # Not promoting (caller will discard the hit —
+                    # scratch lane full — so a device scatter that may
+                    # reclaim-demote OTHER parked sessions would be
+                    # pure waste) or the allocator could not cover the
+                    # cold pages: keep the leading device-resident run
+                    # — always the path's prefix, the resident set is
+                    # ancestor-closed — and let the cold suffix
+                    # re-prefill.
+                    keep = []
+                    for n in nodes:
+                        if n.tier != TIER_DEVICE:
+                            break
+                        keep.append(n)
+                    nodes = keep
+                    m = min(len(nodes) * ps, len(ids) - 1)
+                    if m <= 0:
+                        return None
+            pages = [n.page for n in nodes]
         pages = pages[: -(-m // ps)]
         if m % ps:
             self.allocator.retain([pages[-1]])

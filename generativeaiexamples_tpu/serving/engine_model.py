@@ -1178,6 +1178,66 @@ def pool_to_cache(
     return KVCache(k, v, lengths)
 
 
+@jax.jit
+def pool_to_pages(pool: PagePool, table_row: jax.Array):
+    """Gather `table_row`'s pages out of the pool VERBATIM as
+    page-major arrays — the KV pager's demotion read
+    (serving/kv_pager.py): one batched dispatch moves a whole
+    demotion set device->host. int8 pools hand over codes AND narrow
+    scales untouched (no dequantize — promotion scatters the exact
+    bytes back, so a demote->promote round trip is bit-identical to
+    never having left the pool). Returns (codes, scales):
+
+      codes  [n, 2, L, KH, ps, Hd]  ([:, 0] = k, [:, 1] = v);
+             pool dtype (bf16/f32) or int8 codes for quantized pools
+      scales [n, 2, L, KH, ps] f32 for quantized pools, else None
+
+    Compiles per table_row width — callers pad to a power of two with
+    sink-page zeros (page 0 gathers garbage; the host side slices the
+    valid prefix)."""
+    # pytree-static branch: the pool's TYPE (PagePool vs
+    # QuantPagePool) selects it, not a traced value — the same
+    # shape pool_to_cache carries in lint-baseline.json.
+    if pool.quantized:  # graftlint: ignore[GL101]
+        li, kh, tb = _page_axes(pool.kv.shape[1], pool.kv.shape[2],
+                                table_row)
+        codes = pool.kv[:, li, kh, tb]  # [2, L, KH, n, ps, Hd]
+        scales = pool.s[:, li, kh, tb]  # [2, L, KH, n, ps]
+        return jnp.moveaxis(codes, 3, 0), jnp.moveaxis(scales, 3, 0)
+    li, kh, tb = _page_axes(pool.k.shape[0], pool.k.shape[1], table_row)
+    codes = jnp.stack([pool.k[li, kh, tb], pool.v[li, kh, tb]])
+    return jnp.moveaxis(codes, 3, 0), None
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def pages_to_pool(pool: PagePool, codes: jax.Array,
+                  scales: Optional[jax.Array],
+                  table_row: jax.Array) -> PagePool:
+    """Scatter page-major KV bytes back into the pool at
+    `table_row`'s page ids — pool_to_pages' promotion twin, the
+    sibling of pool_to_cache on the admission path: ONE batched
+    dispatch re-seats every non-resident page a prefix match needs.
+    `codes`/`scales` are exactly pool_to_pages' layout (int8 codes +
+    narrow scales verbatim for quantized pools — never re-quantized).
+    Padding rows carry page id 0 and scatter into the garbage sink."""
+    # pytree-static branch: the pool's TYPE (PagePool vs
+    # QuantPagePool) selects it, not a traced value — the same
+    # shape pool_to_cache carries in lint-baseline.json.
+    if pool.quantized:  # graftlint: ignore[GL101]
+        kq = jnp.moveaxis(codes[:, 0], 0, 2)  # [L, KH, n, ps, Hd]
+        vq = jnp.moveaxis(codes[:, 1], 0, 2)
+        ks = jnp.moveaxis(scales[:, 0], 0, 2)  # [L, KH, n, ps]
+        vs = jnp.moveaxis(scales[:, 1], 0, 2)
+        return _write_quant_pages(pool, kq, vq=vq, ks=ks, vs=vs,
+                                  table_flat=table_row)
+    kw = jnp.moveaxis(codes[:, 0], 0, 2)
+    vw = jnp.moveaxis(codes[:, 1], 0, 2)
+    li, kh, tb = _page_axes(pool.k.shape[0], pool.k.shape[1], table_row)
+    return PagePool(pool.k.at[li, kh, tb].set(kw.astype(pool.k.dtype)),
+                    pool.v.at[li, kh, tb].set(vw.astype(pool.v.dtype)),
+                    pool.page_size)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("pool",))
 def cache_to_pool(

@@ -65,6 +65,12 @@ _COUNTER_KEYS = (
     "prefix_miss", "prefix_evictions", "prefix_hit_tokens",
     "plan_variants_compiled", "spec_fallback_steps",
     "admission_failures", "qos_preemptions",
+    # KV-pager counters and tier gauges (serving/kv_pager.py) sum
+    # across replicas: fleet-wide parked-session pages per tier.
+    "kv_demotions", "kv_promotions", "kv_promote_tokens",
+    "kv_host_pages", "kv_spill_pages", "kv_host_bytes", "kv_spill_bytes",
+    "kv_spill_writes", "kv_spill_compactions", "kv_forced_drops",
+    "kv_pager_errors",
 )
 
 
@@ -304,6 +310,23 @@ class _FleetPrefixCacheView:
         return sum(e.prefix_cache.n_cached_pages for e in self._engines)
 
 
+class _FleetKVPagerView:
+    """Aggregate `kv_pager` facade for /health: stats() sums each
+    local replica's pager counters/gauges, so a fleet whose replicas
+    page KV reports enabled with fleet-wide tiers instead of
+    contradicting /metrics (which sums the same kv_* keys)."""
+
+    def __init__(self, pagers: List):
+        self._pagers = pagers
+
+    def stats(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for p in self._pagers:
+            for k, v in p.stats().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+
 class FleetMetrics:
     """Engine-shaped metrics facade over the whole fleet: snapshot()
     aggregates replica counters and merges the router's own, and the
@@ -434,6 +457,12 @@ class EngineFleet:
         engines = [r.engine for r in self.local_replicas()
                    if r.has_prefix_cache]
         return _FleetPrefixCacheView(engines) if engines else None
+
+    @property
+    def kv_pager(self):
+        pagers = [r.engine.kv_pager for r in self.local_replicas()
+                  if getattr(r.engine, "kv_pager", None) is not None]
+        return _FleetKVPagerView(pagers) if pagers else None
 
     def local_replicas(self) -> List[LocalReplica]:
         return [r for r in self.replicas if isinstance(r, LocalReplica)]

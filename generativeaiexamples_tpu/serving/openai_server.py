@@ -220,6 +220,19 @@ class OpenAIServer:
                 "fused_prefill_tokens": m.fused_prefill_tokens,
                 "prefill_stall_beats": m.prefill_stall_beats,
             }
+        # Session KV pager (serving/kv_pager.py) — always present
+        # (enabled false, zeroed tiers when the knob is off): tier
+        # page counts/bytes plus the demotion/promotion counters, the
+        # capacity story for paused sessions at a glance.
+        kp = getattr(self.llm, "kv_pager", None)
+        if kp is not None:
+            payload["kv_pager"] = {"enabled": True, **kp.stats()}
+        else:
+            from generativeaiexamples_tpu.serving.kv_pager import (
+                KV_PAGER_KEYS)
+
+            payload["kv_pager"] = {"enabled": False,
+                                   **dict.fromkeys(KV_PAGER_KEYS, 0)}
         # Always present, like the fused section: a fleet (serving/
         # fleet.py as the llm object) reports replica states + drain
         # flags; a single engine reports enabled=false so the key never

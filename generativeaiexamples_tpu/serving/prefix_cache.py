@@ -60,9 +60,22 @@ from typing import Callable, List, Optional, Sequence
 
 from generativeaiexamples_tpu.serving.kv_cache import PageAllocator
 
+# KV residency tiers (serving/kv_pager.py). Every node is born
+# TIER_DEVICE (its payload is a live pool page); the pager's demotion
+# flips cold nodes to TIER_HOST (budgeted host-RAM copy) and TIER_DISK
+# (mmap'd spill record), promotion flips them back. TIER_PENDING marks
+# a node selected for demotion whose bytes have not yet left the
+# device (never matched, never re-selected). Plain caches only ever
+# see TIER_DEVICE.
+TIER_DEVICE = 0
+TIER_HOST = 1
+TIER_DISK = 2
+TIER_PENDING = 3
+
 
 class _Node:
-    __slots__ = ("key", "page", "children", "parent", "last_used")
+    __slots__ = ("key", "page", "children", "parent", "last_used",
+                 "tier", "handle", "dev_children")
 
     def __init__(self, key, page, parent):
         self.key = key          # tuple of page_size token ids (root: None)
@@ -70,6 +83,16 @@ class _Node:
         self.parent = parent
         self.children: dict = {}
         self.last_used = 0
+        # KV pager residency (inert for plain caches / shadow trees):
+        # which tier holds this node's KV bytes, the tier-local handle
+        # (host slot / spill slot; None on device — `page` is the
+        # device handle), and how many children are device-resident
+        # (the pager demotes only the device FRONTIER — device nodes
+        # with no device children — so the resident set stays closed
+        # under ancestors and a matched path promotes contiguously).
+        self.tier = TIER_DEVICE
+        self.handle = None
+        self.dev_children = 0
 
 
 class RadixTree:
@@ -93,6 +116,19 @@ class RadixTree:
         self._clock = 0   # monotonic LRU clock (no wall time needed)
         self._n_pages = 0
         self.evictions = 0  # total pages evicted (engine mirrors this)
+        # Lazily-invalidated LRU heap over eviction-frontier nodes,
+        # REUSED across evict() calls (the old per-call rebuild walked
+        # every leaf on the scheduler thread per reclaim — O(tree) per
+        # alloc shortfall, and the KV pager calls evict far more
+        # often). Entries are (last_used-at-push, seq, node); a popped
+        # entry whose node was since touched re-enters with its fresh
+        # timestamp, one that stopped being a frontier node is dropped
+        # (it re-enters when an eviction re-exposes it), so the
+        # EFFECTIVE order is identical to a fresh heap over current
+        # timestamps — pinned by test against the rebuild-per-call
+        # reference.
+        self._heap: list = []
+        self._heap_seq = 0
 
     @property
     def n_cached_pages(self) -> int:
@@ -110,6 +146,36 @@ class RadixTree:
         """May evict() free this leaf right now? (cache: refcount==1)."""
         return True
 
+    def _frontier(self, node: _Node) -> bool:
+        """Is `node` currently on the eviction frontier? Base trees
+        evict leaves; the KV pager's cache demotes device-resident
+        nodes with no device-resident children instead."""
+        return not node.children
+
+    def _evict_node(self, node: _Node) -> None:
+        """Evict one frontier node. Base: unlink it from the tree and
+        release its payload (the PR-1 destroy semantics). The pager's
+        cache overrides this to DEMOTE the node's KV to a colder tier
+        while the node stays in the tree as the pager's index."""
+        if self._reporting():
+            self._report("evict", self._path_ids(node))
+        parent = node.parent
+        del parent.children[node.key]
+        node.parent = None  # dead marker: stale heap entries drop it
+        if node.tier == TIER_DEVICE:
+            parent.dev_children -= 1
+        self._release(node)
+        self._n_pages -= 1
+        if parent is not self.root and self._frontier(parent):
+            self._heap_push(parent)
+
+    def _on_existing(self, node: _Node, payload) -> None:
+        """insert() walked onto an already-present chunk. Base: no-op
+        (dedup — the duplicate payload stays with the caller). The
+        pager's cache re-adopts the fresh device payload when the
+        existing node had been demoted, so a re-played prompt makes
+        its prefix resident again without a promotion dispatch."""
+
     def _reporting(self) -> bool:
         """Is anyone listening? Report ARGUMENTS (token-id tuples,
         root-walk paths) are only built when this is True, so the
@@ -125,6 +191,13 @@ class RadixTree:
     def _touch(self, node: _Node) -> None:
         self._clock += 1
         node.last_used = self._clock
+
+    def _heap_push(self, node: _Node) -> None:
+        """Queue `node` for LRU consideration at its CURRENT
+        timestamp. Touches after the push do not re-queue — evict()
+        re-sorts a stale entry when it surfaces (lazy decrease-key)."""
+        self._heap_seq += 1
+        heapq.heappush(self._heap, (node.last_used, self._heap_seq, node))
 
     def _chunks(self, ids: Sequence[int]):
         ps = self.page_size
@@ -176,14 +249,21 @@ class RadixTree:
             if pages is not None and i >= len(pages):
                 break
             child = node.children.get(chunk)
-            if child is None:
+            created = child is None
+            if created:
                 payload = pages[i] if pages is not None else None
                 self._adopt(payload)
                 child = _Node(chunk, payload, node)
                 node.children[chunk] = child
+                node.dev_children += 1
                 self._n_pages += 1
                 new += 1
+            else:
+                self._on_existing(child,
+                                  pages[i] if pages is not None else None)
             self._touch(child)
+            if created:
+                self._heap_push(child)
             node = child
             walked = i + 1
         if walked and self._reporting():
@@ -191,27 +271,38 @@ class RadixTree:
         return new
 
     def evict(self, n_pages: int) -> int:
-        """Free up to n_pages LRU leaf pages that pass `_evictable`,
-        releasing their payloads. Returns the count actually freed
-        (live-referenced chains are skipped)."""
+        """Free up to n_pages LRU frontier pages that pass
+        `_evictable`, releasing (or, in the pager's cache, demoting)
+        their payloads. Returns the count actually freed
+        (live-referenced chains are skipped).
+
+        Runs off the persistent lazy heap: pops validate that the
+        entry's node is still in the tree, still on the frontier, and
+        still carries the queued timestamp (touched nodes re-enter at
+        their fresh time before being acted on), so eviction order is
+        exactly LRU over current timestamps — O(log n) per considered
+        node instead of an O(tree) leaf walk per call. Entries skipped
+        only for being live-referenced re-enter for the next call."""
         freed = 0
-        heap = [(n.last_used, id(n), n) for n in self._leaves()]
-        heapq.heapify(heap)
+        skipped = []
+        heap = self._heap
         while heap and freed < n_pages:
-            _, _, node = heapq.heappop(heap)
-            if node.children:
-                continue  # gained a child since collection; not a leaf
-            if not self._evictable(node):
+            t, seq, node = heapq.heappop(heap)
+            if node.parent is None or not self._frontier(node):
+                # Evicted since queued, or no longer frontier (gained a
+                # child / was demoted). A node that becomes frontier
+                # again is re-pushed at that transition.
                 continue
-            if self._reporting():
-                self._report("evict", self._path_ids(node))
-            del node.parent.children[node.key]
-            self._release(node)
-            self._n_pages -= 1
+            if node.last_used != t:
+                self._heap_push(node)  # touched since queued: re-sort
+                continue
+            if not self._evictable(node):
+                skipped.append((t, seq, node))
+                continue
+            self._evict_node(node)
             freed += 1
-            parent = node.parent
-            if parent is not self.root and not parent.children:
-                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        for entry in skipped:
+            heapq.heappush(heap, entry)
         self.evictions += freed
         return freed
 

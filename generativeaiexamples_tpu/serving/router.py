@@ -97,12 +97,27 @@ class ShadowRadixTree(RadixTree):
             if node is None:
                 return 0
         removed = 0
+        parent = node.parent
         stack = [node]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
+            # Mark every removed node DEAD (parent=None): the base
+            # tree's persistent eviction heap validates entries by
+            # `parent is None`, and an out-of-band removal that left
+            # the pointer set would let a stale entry pass — evict()
+            # would then re-delete the node's key (KeyError), or worse
+            # delete a re-inserted live twin.
+            n.parent = None
             removed += 1
-        del node.parent.children[node.key]
+        del parent.children[node.key]
+        parent.dev_children -= 1
+        if parent is not self.root and self._frontier(parent):
+            # The removal may have turned the parent into a frontier
+            # leaf: without a re-push it could linger unevictable (its
+            # old heap entry was discarded while it had children) and
+            # trim() would evict fresher nodes instead.
+            self._heap_push(parent)
         self._n_pages -= removed
         self.evictions += removed
         return removed

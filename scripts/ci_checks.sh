@@ -38,7 +38,13 @@
 #                   FIFO on a canned bursty trace, batch tier not
 #                   starved, over-bound requests get a fast 429 +
 #                   Retry-After instead of a hang).
-#   8. tier-1 tests — the ROADMAP.md pytest gate.
+#   8. KV-pager smoke — CPU gate for the session KV pager
+#                   (scripts/smoke_kv_pager.py: sessions beyond pool
+#                   capacity survive demotion at >= 4x the HBM-only
+#                   count, warm resume from the host tier is
+#                   byte-identical to never-demoted greedy,
+#                   promotions observed).
+#   9. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -82,6 +88,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "QoS smoke (JAX_PLATFORMS=cpu scripts/smoke_qos.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_qos.py || fail=1
+
+    step "KV-pager smoke (JAX_PLATFORMS=cpu scripts/smoke_kv_pager.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_kv_pager.py || fail=1
 
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -205,6 +205,59 @@ class TestShadowConsistency:
         assert shadow.match_tokens([2] * 8) == 0
         assert shadow.match_tokens([1] * 8) == 8
 
+    def test_remove_path_then_trim_drops_stale_heap_entries(self):
+        """An out-of-band removal (replica eviction report) must mark
+        removed nodes dead for the persistent eviction heap: a later
+        trim() over fresh inserts used to pop the removed node's stale
+        entry and KeyError on the placement path — or, when the same
+        chunk was re-inserted first, delete the live twin."""
+        shadow = ShadowRadixTree(PS, 2)
+        shadow.insert(list(range(PS)))
+        shadow.remove_path(list(range(PS)))
+        shadow.insert([100 + i for i in range(2 * PS)])
+        shadow.insert([200 + i for i in range(2 * PS)])
+        assert shadow.trim() == 2  # used to KeyError on the stale entry
+        assert shadow.n_cached_pages == 2
+        # Re-inserted twin of a removed chunk survives its stale entry.
+        twin = ShadowRadixTree(PS, 100)
+        twin.insert(list(range(PS)))
+        twin.remove_path(list(range(PS)))
+        twin.insert(list(range(PS)))
+        assert twin.evict(1) == 1 and twin.n_cached_pages == 0
+
+    def test_remove_path_exposes_parent_to_eviction(self):
+        """Removing a subtree must re-queue the surviving parent when
+        it becomes a frontier leaf. On a 3-deep chain A->B->D,
+        evict(1) discards A's and B's heap entries (not frontier),
+        evicts D and re-queues only B; a replica eviction report then
+        removing B leaves A with NO heap entry — without the re-push
+        A is permanently unevictable (trim() evicts fresher nodes
+        instead: LRU inversion + unbounded stale growth)."""
+        shadow = ShadowRadixTree(PS, 100)
+        shadow.insert(list(range(3 * PS)))       # A -> B -> D
+        assert shadow.evict(1) == 1              # D out; only B re-queued
+        shadow.remove_path(list(range(2 * PS)))  # report drops B
+        assert shadow.n_cached_pages == 1        # A survives...
+        assert shadow.evict(1) == 1              # ...and is evictable
+        assert shadow.n_cached_pages == 0
+
+    def test_fleet_kv_pager_view_sums_replica_stats(self):
+        """/health's fleet kv_pager facade: enabled when any local
+        replica pages KV, stats summed — never contradicting /metrics
+        (which sums the same kv_* keys)."""
+        from generativeaiexamples_tpu.serving.fleet import (
+            _FleetKVPagerView)
+
+        class _P:
+            def __init__(self, n):
+                self._n = n
+
+            def stats(self):
+                return {"kv_demotions": self._n, "kv_host_pages": 2}
+
+        view = _FleetKVPagerView([_P(3), _P(5)])
+        assert view.stats() == {"kv_demotions": 8, "kv_host_pages": 4}
+
 
 # ---------------------------------------------------------------------------
 # fleet lifecycle with fake replicas (no engines)

@@ -151,6 +151,63 @@ def test_health_and_metrics_surface_fleet_counters(server):
     assert m["router_queue_depth"] == {}
 
 
+def test_health_and_metrics_surface_kv_pager_counters(server):
+    """The session-KV-pager surface follows the always-present
+    convention: /health carries a kv_pager section (enabled=false,
+    zeroed tiers) and /metrics reports every kv_* key as 0 — never
+    absent — when engine.kv_pager is off."""
+    from generativeaiexamples_tpu.serving.kv_pager import KV_PAGER_KEYS
+
+    async def body(c):
+        h = await (await c.get("/health")).json()
+        m = await (await c.get("/metrics")).json()
+        return h, m
+
+    h, m = _client_call(server, body)
+    assert h["kv_pager"]["enabled"] is False
+    for key in KV_PAGER_KEYS:
+        assert h["kv_pager"][key] == 0
+        assert m[key] == 0
+
+
+def test_health_kv_pager_section_with_pager_enabled():
+    """A kv_pager-enabled engine's /health section carries the live
+    tier gauges from the pager's stats()."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    class _Pager:
+        def stats(self):
+            from generativeaiexamples_tpu.serving.kv_pager import (
+                KV_PAGER_KEYS)
+            out = dict.fromkeys(KV_PAGER_KEYS, 0)
+            out.update({"kv_demotions": 7, "kv_promotions": 3,
+                        "kv_host_pages": 4, "kv_spill_pages": 2})
+            return out
+
+    class _Metrics:
+        def snapshot(self):
+            return {}
+
+    class _LLM:
+        metrics = _Metrics()
+        kv_pager = _Pager()
+
+    async def runner():
+        srv = OpenAIServer(_LLM())
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            return await (await client.get("/health")).json()
+        finally:
+            await client.close()
+
+    h = asyncio.run(runner())
+    assert h["kv_pager"]["enabled"] is True
+    assert h["kv_pager"]["kv_demotions"] == 7
+    assert h["kv_pager"]["kv_host_pages"] == 4
+    assert h["kv_pager"]["kv_spill_pages"] == 2
+
+
 def test_fleet_server_streams_and_health(server):
     """An OpenAIServer whose llm object IS a fleet: streaming works
     through the router unchanged, /health carries replica states, and

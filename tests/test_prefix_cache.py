@@ -201,6 +201,111 @@ class TestRadixPrefixCache:
         assert t.reclaimable() == 3
 
 
+class TestEvictionOrderPinned:
+    """The lazy persistent heap (one heap reused across evict() calls,
+    stale entries re-sorted on pop) must evict in EXACTLY the order of
+    the old rebuild-per-call implementation: LRU over current
+    timestamps among frontier leaves, live-referenced chains skipped,
+    parents exposed back-to-front."""
+
+    class _Recorder(RadixPrefixCache):
+        def __init__(self, *a):
+            super().__init__(*a)
+            self.freed = []
+
+        def _release(self, node):
+            self.freed.append(node.page)
+            super()._release(node)
+
+    def _apply_ops(self, t, a, ops):
+        """Deterministic workload: chains with shared prefixes,
+        touches, interleaved evictions."""
+        order = []
+        for kind, arg in ops:
+            if kind == "insert":
+                pages = a.alloc(len(arg) // t.page_size)
+                t.insert(arg, pages)
+                a.release(pages)
+            elif kind == "touch":
+                t.match(arg)
+            elif kind == "evict":
+                before = len(t.freed)
+                t.evict(arg)
+                order.append(tuple(t.freed[before:]))
+        return order
+
+    def test_order_identical_to_rebuild_per_call_reference(self):
+        ps = 4
+        head = list(range(8))
+        ops = [
+            ("insert", head + [20, 21, 22, 23]),
+            ("insert", head + [30, 31, 32, 33, 34, 35, 36, 37]),
+            ("insert", [90 + i for i in range(12)]),
+            ("touch", head + [30, 31, 32, 33]),
+            ("evict", 2),
+            ("insert", [70 + i for i in range(8)]),
+            ("touch", [90 + i for i in range(8)]),
+            ("evict", 3),
+            ("evict", 10),
+        ]
+
+        def build():
+            a = PageAllocator(64)
+            return a, self._Recorder(a, ps, 100)
+
+        a1, t_new = build()
+        got = self._apply_ops(t_new, a1, ops)
+
+        # Same workload against the pre-PR algorithm, kept verbatim as
+        # the order oracle: fresh heap over every leaf per call.
+        a2, t_ref = build()
+
+        def ref_evict(n, _t=t_ref):
+            import heapq
+            freed = 0
+            heap = [(n_.last_used, id(n_), n_) for n_ in _t._leaves()]
+            heapq.heapify(heap)
+            while heap and freed < n:
+                _, _, node = heapq.heappop(heap)
+                if node.children:
+                    continue
+                if not _t._evictable(node):
+                    continue
+                del node.parent.children[node.key]
+                _t._release(node)
+                _t._n_pages -= 1
+                freed += 1
+                parent = node.parent
+                if parent is not _t.root and not parent.children:
+                    heapq.heappush(heap, (parent.last_used, id(parent),
+                                          parent))
+            _t.evictions += freed
+            return freed
+
+        t_ref.evict = ref_evict
+        want = self._apply_ops(t_ref, a2, ops)
+        assert got == want
+        assert t_new.n_cached_pages == t_ref.n_cached_pages
+
+    def test_evict_never_rebuilds_from_a_leaf_walk(self):
+        """The satellite perf contract: evict() must run off the
+        incremental heap — an O(tree) `_leaves()` walk per call is the
+        regression this pins against."""
+        a = PageAllocator(64)
+        t = self._Recorder(a, 4, 100)
+        pages = a.alloc(4)
+        t.insert(list(range(16)), pages)
+        a.release(pages)
+
+        def boom():
+            raise AssertionError("evict() walked every leaf")
+
+        t._leaves = boom
+        assert t.evict(2) == 2
+        t.match(list(range(16)))  # touch survivors
+        assert t.evict(10) == 2
+
+
 def _engine(**kw):
     params = llama.init_params(TINY, jax.random.PRNGKey(0))
     # kv_dtype float32 == TINY's model dtype: the prefix gather is then
