@@ -121,6 +121,19 @@ Scenario output keys (under "extras"):
                  cores, not a second chip; on a 1-core container
                  fleet_speedup honestly reads contention, keyed by
                  fleet_cpu_count. BENCH_FLEET=0 skips)
+  flight recorder: flight_overhead_pct, flight_on_tok_s,
+                 flight_off_tok_s (the always-on flight recorder's
+                 cost pin: one extra headline-shaped burst with the
+                 recorder toggled OFF at runtime vs one with it back
+                 ON, serving/flight.py — the recorder defaults ON, so
+                 the headline itself already includes it; this extra
+                 proves the inclusion is free. BENCH_FLIGHT=0 skips)
+                 + from the fused scenario: flight_timeline_path (a
+                 Perfetto-loadable Chrome-trace artifact under build/),
+                 flight_attributed_pct and flight_top_gap_causes
+                 (scripts/analyze_timeline.py stall attribution over
+                 the fused run — device-busy / host-gap / idle + named
+                 causes summing to ~100% of wall)
   QoS goodput:   qos_goodput_latency_tier, qos_goodput_batch_tier,
                  qos_shed_rate, qos_fifo_goodput_baseline,
                  qos_preemptions, qos_fifo_goodput_batch,
@@ -143,8 +156,11 @@ Scenario output keys (under "extras"):
 Sibling tooling (same checkout):
   scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_tiered_ann.py /
   smoke_microbatch.py / smoke_fused_step.py / smoke_plan_step.py /
-  smoke_router.py / smoke_kv_pager.py
+  smoke_router.py / smoke_kv_pager.py / smoke_flight.py
       targeted CPU smoke gates for the serving subsystems
+  scripts/analyze_timeline.py build/timeline_fused.json
+      stall attribution over a /debug/timeline (or bench) artifact:
+      device-busy / host-gap / idle split + named top gap causes
   scripts/bench_fleet.py
       the fleet scenario as a standalone CPU tool (multi-replica
       aggregate throughput + router hit-rate)
@@ -357,10 +373,14 @@ def main() -> None:
           file=sys.stderr)
 
     lock = threading.Lock()
-    tps_runs = []
-    wall_runs = []
-    ttfts = []
-    for run_i in range(repeat):
+
+    def headline_burst():
+        """ONE full-batch burst in the pinned headline shape: every
+        worker streams `gen` tokens and records its own TTFT; returns
+        ([(n_tokens, first_s)], wall_s). The flight-recorder overhead
+        extra reuses this exact function, so the on/off pair measures
+        the same burst the headline does — two hand-rolled twins
+        would drift."""
         results = []
 
         def worker():
@@ -375,19 +395,25 @@ def main() -> None:
             with lock:
                 results.append((n, first))
 
-        # Phase boundary (part of the PINNED provenance): the sliding-
-        # window gauge must cover ONLY the burst (the idle gap after
-        # the warmup smoke otherwise stretches its span and under-
-        # reads ~8% — r4 VERDICT weak #6), and wall stops only after
-        # every worker drained its stream.
-        eng.metrics.reset_window()
         t0 = time.perf_counter()
         threads = [threading.Thread(target=worker) for _ in range(batch)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        wall = time.perf_counter() - t0
+        return results, time.perf_counter() - t0
+
+    tps_runs = []
+    wall_runs = []
+    ttfts = []
+    for run_i in range(repeat):
+        # Phase boundary (part of the PINNED provenance): the sliding-
+        # window gauge must cover ONLY the burst (the idle gap after
+        # the warmup smoke otherwise stretches its span and under-
+        # reads ~8% — r4 VERDICT weak #6), and wall stops only after
+        # every worker drained its stream.
+        eng.metrics.reset_window()
+        results, wall = headline_burst()
         total_tokens = sum(n for n, _ in results)
         tps_runs.append(total_tokens / wall)
         wall_runs.append(wall)
@@ -404,6 +430,31 @@ def main() -> None:
     import statistics
 
     snap = eng.metrics.snapshot()
+
+    # -- flight-recorder overhead pin (ISSUE 12): the recorder is ON
+    # by default, so every headline run above already paid it. One
+    # extra headline-shaped burst with the recorder toggled OFF at
+    # runtime, then one with it back ON (paired — same engine, same
+    # compile state, adjacent in time), reports what the always-on
+    # default costs. smoke_flight.py asserts the <= 1% bound on CPU;
+    # here the measured number simply rides the artifact.
+    flight_stats = {}
+    if os.environ.get("BENCH_FLIGHT", "1") != "0":
+        def _flight_tok_s() -> float:
+            results, wall = headline_burst()
+            return sum(n for n, _ in results) / wall
+
+        eng.flight.set_enabled(False)
+        off_tps = _flight_tok_s()
+        eng.flight.set_enabled(True)
+        on_tps = _flight_tok_s()
+        flight_stats = {
+            "flight_off_tok_s": round(off_tps, 1),
+            "flight_on_tok_s": round(on_tps, 1),
+            "flight_overhead_pct": round(
+                (off_tps - on_tps) / off_tps * 100.0, 2) if off_tps
+            else None,
+        }
 
     # TTFT under REALISTIC load: 16 requests arriving staggered over
     # ~2 s (the VERDICT r1 bar is p50 <= 300 ms under 16-way load; the
@@ -628,6 +679,7 @@ def main() -> None:
                                for k, v in snap.items()},
             "throughput_provenance": THROUGHPUT_PROVENANCE,
             "backend": jax.default_backend(),
+            **flight_stats,
             **longctx_stats,
             **fused_stats,
             **prefix_stats,
@@ -815,6 +867,32 @@ def _bench_fused(params, cfg, longctx_stats):
                           fused_prefill=True)
     first, gaps_before, gaps_during = _gaps_under_8k_prefill(eng)
     snap = eng.metrics.snapshot()
+    # Perfetto-loadable timeline artifact + stall attribution over the
+    # fused run (ISSUE 12 acceptance: the analyzer must name >= 95% of
+    # wall, and the artifact lands under build/ for human Perfetto
+    # reads of the same workload the gap numbers describe).
+    flight_keys = {}
+    try:
+        from generativeaiexamples_tpu.serving.flight import chrome_trace
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from scripts.analyze_timeline import analyze
+
+        trace = chrome_trace({"fused": eng.flight})
+        os.makedirs("build", exist_ok=True)
+        path = os.path.join("build", "timeline_fused.json")
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        rep = analyze(trace)
+        flight_keys = {
+            "flight_timeline_path": path,
+            "flight_timeline_beats": sum(v["beats"]
+                                         for v in rep["lanes"].values()),
+            "flight_attributed_pct": rep["overall"]["attributed_pct"],
+            "flight_top_gap_causes": rep["overall"]["top_causes"],
+        }
+    except Exception as e:
+        flight_keys = {"flight_timeline_error": f"{type(e).__name__}: {e}"}
     eng.stop()
     del eng
     gc.collect()
@@ -823,6 +901,7 @@ def _bench_fused(params, cfg, longctx_stats):
         "short_stream_gap_p95_during_8k_prefill_ms")
     fused_gap = _p95_ms(gaps_during)
     return {
+        **flight_keys,
         "fused_ttft_8k_under_load_ms": round(first * 1e3, 1),
         "fused_gap_p95_before_ms": _p95_ms(gaps_before),
         "fused_gap_p95_during_8k_prefill_ms": fused_gap,

@@ -353,6 +353,22 @@ class EngineConfig:
     # its first token) — the preemption that keeps a tenant's 8k flood
     # from sitting in front of every interactive caller.
     qos_preempt_prefill: bool = True
+    # Engine flight recorder (serving/flight.py): one compact record
+    # per scheduling beat (StepPlan lattice point, dispatch->ready
+    # device interval vs host-side gap, busy/waiting slots per tier,
+    # pager page moves) plus request lifecycle events (submit / qos
+    # pick / admit / prefill chunks / first token / retire), written
+    # into preallocated single-writer ring buffers and served at
+    # /debug/timeline as Perfetto-loadable Chrome trace JSON
+    # (scripts/analyze_timeline.py turns it into stall attribution).
+    # Default ON: the append is O(1), lock-free and allocation-free —
+    # overhead is pinned <= 1% by scripts/smoke_flight.py and
+    # reported as a bench extra (flight_overhead_pct).
+    flight_recorder: bool = True
+    # Beat-ring capacity in records (the lifecycle-event ring is 4x
+    # this). At one record per landed decode block, 4096 covers
+    # minutes of saturated serving; older records overwrite in place.
+    flight_ring_size: int = 4096
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
 

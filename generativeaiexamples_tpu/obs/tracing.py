@@ -32,6 +32,55 @@ _PROVIDER = None
 _BACKEND = None  # "otel" | "mini"
 _TLS = threading.local()  # mini-backend attached context
 
+# Export/attribute failure accounting (the trainer idiom: logged once,
+# counted always — a sick exporter must show up in /metrics, not
+# silently drop span enrichment). Surfaced by EngineMetrics.snapshot()
+# as the always-present `trace_export_errors` counter.
+_ERR_LOCK = threading.Lock()
+_EXPORT_ERRORS = 0
+_ERR_LOGGED = False
+
+
+def note_trace_error(where: str, exc: Optional[BaseException] = None) -> None:
+    """Count one span export/attribute failure; log the FIRST one at
+    warning (with traceback when given) so the log isn't flooded but
+    the failure mode is never invisible."""
+    global _EXPORT_ERRORS, _ERR_LOGGED
+    with _ERR_LOCK:
+        _EXPORT_ERRORS += 1
+        first = not _ERR_LOGGED
+        _ERR_LOGGED = True
+    if first:
+        _LOG.warning("span %s failed (counted in trace_export_errors; "
+                     "further failures logged at debug)", where,
+                     exc_info=exc)
+    else:
+        _LOG.debug("span %s failed", where, exc_info=exc)
+
+
+def trace_export_errors() -> int:
+    """Total span export/attribute failures this process (monotonic)."""
+    with _ERR_LOCK:
+        return _EXPORT_ERRORS
+
+
+def span_trace_id(manual_span) -> str:
+    """Hex trace id of a ManualSpan (or "" when tracing is off / the
+    span is closed) — the rid <-> trace-id correlation key the flight
+    recorder stamps onto retire events so /debug/timeline request
+    spans link back to the request's distributed trace."""
+    sp = getattr(manual_span, "_span", None)
+    if sp is None:
+        return ""
+    try:
+        ctx = getattr(sp, "context", None)
+        if ctx is None and hasattr(sp, "get_span_context"):
+            ctx = sp.get_span_context()
+        tid = getattr(ctx, "trace_id", 0)
+        return f"{tid:032x}" if tid else ""
+    except Exception:
+        return ""
+
 
 # ---------------------------------------------------------------------------
 # Built-in minimal tracer (used when the otel SDK is unavailable)
@@ -82,8 +131,9 @@ class _MiniSpan:
         for ex in self._exporters:
             try:
                 ex.export([self])
-            except Exception:
-                pass
+            except Exception as e:
+                # Counted, logged once — never silently dropped.
+                note_trace_error("export", e)
 
     # context-manager protocol so `with span(...)` keeps working
     def __enter__(self):
@@ -397,9 +447,17 @@ class ManualSpan:
             for k, v in get_system_metrics().items():
                 try:
                     self._span.set_attribute(k, v)
-                except Exception:
-                    break
-            self._span.end()
+                except Exception as e:
+                    # One bad attribute must not drop the REST of the
+                    # system-metric set (the old `break` silently lost
+                    # every attribute after the first failure): count
+                    # it, log once, keep going.
+                    note_trace_error(f"set_attribute({k})", e)
+                    continue
+            try:
+                self._span.end()
+            except Exception as e:
+                note_trace_error("end", e)
             self._span = None
 
 

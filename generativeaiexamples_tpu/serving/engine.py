@@ -66,7 +66,13 @@ from generativeaiexamples_tpu.models.llama import LlamaConfig
 from generativeaiexamples_tpu.serving import engine_model
 from generativeaiexamples_tpu.serving.kv_cache import (
     PageAllocator, PagePool, SequencePages)
-from generativeaiexamples_tpu.serving.qos import request_tier
+from generativeaiexamples_tpu.serving import flight as flight_mod
+from generativeaiexamples_tpu.serving.flight import (
+    EV_ADMIT, EV_ADMIT_RETRY, EV_FIRST_TOKEN, EV_KV_DEMOTE, EV_KV_PROMOTE,
+    EV_PREFILL_CHUNK, EV_PREFILL_DISPATCH, EV_QOS_PAUSE, EV_QOS_PICK,
+    EV_QOS_RESUME, EV_RETIRE, EV_SUBMIT, RETIRE_CODES, ExpHistogram,
+    FlightRecorder)
+from generativeaiexamples_tpu.serving.qos import request_tier, tier_id
 from generativeaiexamples_tpu.utils.tokenizer import StreamDetokenizer
 
 _LOG = logging.getLogger(__name__)
@@ -134,6 +140,11 @@ class GenRequest:
     cancelled: bool = False  # set by the server on client disconnect/stop
     truncate_prompt: bool = False  # opt-in: clamp instead of reject
     trace_context: Any = None  # OTel context from the caller (W3C)
+    # Flight-recorder bookkeeping (scheduler thread only): submit is
+    # recorded RETROACTIVELY at the first admission pop (stamped with
+    # submit_time) so server threads never write the ring; the flag
+    # keeps requeued requests from logging a duplicate submit.
+    flight_seen: bool = False
 
 
 class _Slot:
@@ -183,10 +194,16 @@ class _InFlight:
     """One dispatched-but-unprocessed decode block."""
 
     __slots__ = ("block", "metas", "K", "releases", "spec_worst",
-                 "plain_spec")
+                 "plain_spec", "t_dispatch", "plan")
 
     def __init__(self, block, metas, K, spec_worst: int = 0,
                  plain_spec: bool = False):
+        # Flight-recorder provenance: perf_counter at dispatch return
+        # and the StepPlan lattice point this block ran (stamped by
+        # _dispatch_decode; zero/None on inline test drivers that
+        # build _InFlight by hand).
+        self.t_dispatch = 0.0
+        self.plan = None
         # Plain blocks: device [B, K+1]. Speculative blocks: a
         # (targets [B, K, r], counts [B, K]) tuple.
         self.block = block
@@ -249,8 +266,14 @@ class EngineMetrics:
     RATE_WINDOW_S = 30.0  # tokens_per_sec sliding window
 
     def __init__(self):
-        # Bounded: p50/p95 over a sliding window, constant memory/scrape cost.
-        self.ttft_ms: deque = deque(maxlen=4096)
+        # Exponential-bucket latency histograms (serving/flight.py)
+        # replacing the old p50/p95 sliding deque: constant memory,
+        # mergeable across a fleet, native Prometheus export. Single-
+        # writer (scheduler thread observes, scrapes copy). Keys here
+        # are HIST_KEYS minus the "hist_" prefix; snapshot() emits the
+        # prefixed form, empty-but-present when idle.
+        self.hists = {k[len("hist_"):]: ExpHistogram()
+                      for k in flight_mod.HIST_KEYS}
         self.tokens_out = 0
         self.decode_steps = 0
         self.busy_slots_acc = 0
@@ -302,14 +325,19 @@ class EngineMetrics:
         # off) emits zeros for every KV_PAGER_KEYS key — present,
         # never absent, like the router/QoS counters.
         self.kv_pager_stats = None
+        # Flight recorder (serving/flight.py): same hook shape as the
+        # pager — the engine installs its recorder's stats() so every
+        # scrape reads live beat/event counters; None emits zeros for
+        # every FLIGHT_KEYS key (present, never absent).
+        self.flight_stats = None
         self.started = time.perf_counter()
         # (timestamp, n_tokens) per decode dispatch for the sliding rate.
         self._token_events: deque = deque(maxlen=8192)
         self._lock = threading.Lock()  # scheduler appends vs scrape iterates
 
     def record_ttft(self, ms: float) -> None:
-        with self._lock:
-            self.ttft_ms.append(ms)
+        # Scheduler thread only (single-writer, like every histogram).
+        self.hists["ttft_ms"].observe(ms)
 
     def record_tokens(self, n: int) -> None:
         if n <= 0:
@@ -349,13 +377,17 @@ class EngineMetrics:
         return total / span
 
     def snapshot(self) -> Dict[str, Any]:
-        with self._lock:
-            t = sorted(self.ttft_ms)
-        pct = lambda p: t[int(p * (len(t) - 1))] if t else None  # noqa: E731
+        hist_snaps = {f"hist_{k}": h.snapshot()
+                      for k, h in self.hists.items()}
+        ttft = hist_snaps["hist_ttft_ms"]
         occ = (self.busy_slots_acc / self.decode_steps
                if self.decode_steps else 0.0)
         out = {
-            "ttft_p50_ms": pct(0.5), "ttft_p95_ms": pct(0.95),
+            # Estimated from the exponential-bucket histogram (the old
+            # sliding deque's exact-window percentiles were neither
+            # mergeable across a fleet nor Prometheus-exportable);
+            # None until a first token has been recorded, as before.
+            "ttft_p50_ms": ttft["p50"], "ttft_p95_ms": ttft["p95"],
             "tokens_generated": self.tokens_out,
             "decode_steps": self.decode_steps,
             "mean_batch_occupancy": occ,
@@ -404,6 +436,20 @@ class EngineMetrics:
             out.update(self.kv_pager_stats())
         else:
             out.update(dict.fromkeys(KV_PAGER_KEYS, 0))
+        # Flight recorder + histograms (serving/flight.py): the same
+        # always-present contract — FLIGHT_KEYS zeros and empty-but-
+        # present histogram dicts when the recorder/engine is idle.
+        if self.flight_stats is not None:
+            out.update(self.flight_stats())
+        else:
+            out.update(dict.fromkeys(flight_mod.FLIGHT_KEYS, 0))
+        out.update(hist_snaps)
+        # Span-export honesty (obs/tracing.py): attribute/export
+        # failures are logged once and COUNTED, never swallowed.
+        from generativeaiexamples_tpu.obs.tracing import (
+            trace_export_errors)
+
+        out["trace_export_errors"] = trace_export_errors()
         return out
 
 
@@ -535,6 +581,23 @@ class LLMEngine:
         self.metrics = EngineMetrics()
         if self.kv_pager is not None:
             self.metrics.kv_pager_stats = self.kv_pager.stats
+        # Flight recorder (serving/flight.py): one beat record per
+        # landed decode block + request lifecycle events, written by
+        # the scheduler thread only into preallocated rings. Always
+        # constructed (the stats()/timeline surfaces must exist);
+        # engine.flight_recorder=False turns appends into one branch.
+        self.flight = FlightRecorder(
+            ring_size=self.ecfg.flight_ring_size,
+            enabled=self.ecfg.flight_recorder)
+        self.metrics.flight_stats = self.flight.stats
+        # Scheduler-thread beat bookkeeping for the recorder: previous
+        # beat's host-ready stamp (drives the beat-gap histogram and
+        # host-gap attribution) and pager pages moved since the last
+        # record (promote in _lookup_prefix / demote in the reclaim
+        # hook, both scheduler-side).
+        self._last_beat_ready = 0.0
+        self._beat_kv_demote = 0
+        self._beat_kv_promote = 0
         # SLO-aware multi-tenant QoS (serving/qos.py): None = the FIFO
         # admission path, byte-identical to the pre-QoS scheduler. With
         # engine.qos on, admission order comes from the weighted-fair
@@ -1209,22 +1272,7 @@ class LLMEngine:
                     self._fail_active()
                     break
             if self._inflight:
-                fl = self._inflight.popleft()
-                try:
-                    self._process_block_host(fl, self._fetch_block_host(fl))
-                except Exception:
-                    _LOG.exception("decode block failed; failing batch")
-                    self._fail_active()
-                finally:
-                    # Pages parked on this block are released even on
-                    # failure — they back retired slots this very block
-                    # may still have written to.
-                    for seq in fl.releases:
-                        seq.release()
-                    fl.releases = []
-                self._reap_starved()
-                self._beat += 1
-                self._note_prefill_stalls()
+                self._land_next_block()
                 did_work = True
             elif self._pending_first:
                 # No blocks in flight but first tokens still en route
@@ -1234,8 +1282,84 @@ class LLMEngine:
                 self._wake.clear()
                 continue
             if not did_work:
+                # Idle boundary: the beat-gap histogram measures the
+                # inter-block cadence WITHIN an active period — one
+                # 10-minute idle stretch must not inject a giant
+                # sample that drowns the stall signal the histogram
+                # exists to expose.
+                self._last_beat_ready = 0.0
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()
+
+    # graftlint: hot-path
+    def _land_next_block(self) -> None:
+        """Land the oldest in-flight block: fetch (reader thread),
+        process/emit, release parked pages, advance the beat, and
+        write the beat's flight record. One scheduling beat end to
+        end — inline test drivers call this instead of replicating
+        the loop body."""
+        fl = self._inflight.popleft()
+        tokens_before = self.metrics.tokens_out
+        t_ready = 0.0
+        try:
+            host = self._fetch_block_host(fl)
+            t_ready = time.perf_counter()
+            self._process_block_host(fl, host)
+        except Exception:
+            _LOG.exception("decode block failed; failing batch")
+            self._fail_active()
+        finally:
+            # Pages parked on this block are released even on
+            # failure — they back retired slots this very block
+            # may still have written to.
+            for seq in fl.releases:
+                seq.release()
+            fl.releases = []
+        self._reap_starved()
+        self._beat += 1
+        self._note_prefill_stalls()
+        self._record_beat(fl, t_ready,
+                          self.metrics.tokens_out - tokens_before)
+
+    # graftlint: hot-path
+    def _record_beat(self, fl: _InFlight, t_ready: float,
+                     emitted: int) -> None:
+        """Write one beat record (and the beat-gap histogram sample)
+        for a just-landed block. The histogram is always live; the
+        ring append is one branch when the recorder is off."""
+        prev = self._last_beat_ready
+        if t_ready:
+            if prev:
+                self.metrics.hists["beat_gap_ms"].observe(
+                    (t_ready - prev) * 1e3)
+            self._last_beat_ready = t_ready
+        if not self.flight.enabled:
+            self._beat_kv_demote = self._beat_kv_promote = 0
+            return
+        busy = [0, 0, 0]
+        for s in self.slots:
+            if s is not None and not s.req.cancelled:
+                busy[tier_id(s.req)] += 1
+        d = self.metrics.qos_queue_depth
+        plan = fl.plan
+        self.flight.record_beat(
+            t_dispatch=fl.t_dispatch, t_ready=t_ready or fl.t_dispatch,
+            t_prev_ready=prev,
+            decode_k=plan.decode_k if plan is not None else fl.K,
+            spec_k=plan.spec_k if plan is not None else 0,
+            tree_branches=plan.tree_branches if plan is not None else 0,
+            rider_width=plan.rider_width if plan is not None else 0,
+            rider_s_total=plan.rider_s_total if plan is not None else 0,
+            spec_state=bool(plan.spec_state) if plan is not None
+            else fl.plain_spec,
+            fused_rider=bool(plan is not None and plan.rider_width),
+            qos_paused=any(lp.paused for lp in self._long_prefills),
+            busy=(busy[0], busy[1], busy[2]),
+            wait=(d["latency"], d["standard"], d["batch"]),
+            tokens_emitted=emitted,
+            kv_demote_pages=self._beat_kv_demote,
+            kv_promote_pages=self._beat_kv_promote)
+        self._beat_kv_demote = self._beat_kv_promote = 0
 
     def _reader_loop(self) -> None:
         """Blocking host readbacks, off the scheduler thread. Engaged
@@ -1359,6 +1483,68 @@ class LLMEngine:
         tier = request_tier(req)
         d[tier] = max(0, d[tier] + delta)
 
+    # -- flight-recorder lifecycle hooks (scheduler thread only) -----------
+
+    # graftlint: hot-path
+    def _flight_note_pop(self, req: GenRequest) -> None:
+        """Record the request's submit (retroactively, stamped with
+        its submit_time — server threads never write the ring) and,
+        under engine.qos, the weighted-fair pick that chose it."""
+        if not self.flight.enabled:
+            return
+        tier = tier_id(req)
+        if not req.flight_seen:
+            req.flight_seen = True
+            if not req.request_id:
+                # Engine-direct callers (bench, generate_stream) have
+                # no server-issued id; synthesize one so their
+                # lifecycle events still correlate into timeline spans.
+                req.request_id = f"req-{self.flight.stats()['flight_events']}"
+            self.flight.record_event(EV_SUBMIT, req.submit_time,
+                                     rid=req.request_id, tier=tier,
+                                     a=float(len(req.prompt_ids)))
+        if self.qos is not None:
+            self.flight.record_event(EV_QOS_PICK, time.perf_counter(),
+                                     rid=req.request_id, tier=tier)
+
+    # graftlint: hot-path
+    def _flight_admit(self, req: GenRequest, slot_idx: int) -> None:
+        """Slot reserved: observe the per-tier queue-wait histogram
+        (always live) and record the admit event."""
+        now = time.perf_counter()
+        wait_ms = max(0.0, (now - req.submit_time) * 1e3)
+        tier = request_tier(req)
+        self.metrics.hists["queue_wait_ms_" + tier].observe(wait_ms)
+        if self.flight.enabled:
+            self.flight.record_event(EV_ADMIT, now, rid=req.request_id,
+                                     tier=tier_id(tier),
+                                     slot=slot_idx, a=wait_ms)
+
+    # graftlint: hot-path
+    def _flight_first(self, slot: "_Slot", ttft_ms: float) -> None:
+        self.flight.record_event(
+            EV_FIRST_TOKEN, time.perf_counter(),
+            rid=slot.req.request_id,
+            tier=tier_id(slot.req), a=ttft_ms)
+
+    # graftlint: hot-path
+    def _flight_retire(self, slot: "_Slot", reason: str) -> None:
+        """Slot retired: observe the e2e-latency histogram and record
+        the retire event (reason code, token count, e2e ms, and the
+        rid <-> trace-id correlation when a span is live)."""
+        now = time.perf_counter()
+        e2e_ms = max(0.0, (now - slot.req.submit_time) * 1e3)
+        self.metrics.hists["e2e_ms"].observe(e2e_ms)
+        if not self.flight.enabled:
+            return
+        from generativeaiexamples_tpu.obs.tracing import span_trace_id
+
+        self.flight.record_event(
+            EV_RETIRE, now, rid=slot.req.request_id,
+            tier=tier_id(slot.req),
+            code=RETIRE_CODES.get(reason, -1), a=float(slot.generated),
+            b=e2e_ms, aux=span_trace_id(slot.span))
+
     # graftlint: hot-path
     def _qos_pop_waiting(self) -> GenRequest:
         """Weighted-fair admission pop (engine.qos on; self._lock
@@ -1385,8 +1571,15 @@ class LLMEngine:
                 or not self.ecfg.qos_preempt_prefill:
             return
         pressure = self._qos_latency_pressure()
+        now = 0.0
         for lp in self._long_prefills:
             should = pressure and lp.tier != "latency"
+            if should != lp.paused and self.flight.enabled:
+                now = now or time.perf_counter()
+                self.flight.record_event(
+                    EV_QOS_PAUSE if should else EV_QOS_RESUME, now,
+                    rid=lp.req.request_id,
+                    tier=tier_id(lp.tier), a=float(lp.pos))
             if should and not lp.paused:
                 self.metrics.qos_preemptions += 1
             lp.paused = should
@@ -1430,6 +1623,7 @@ class LLMEngine:
                 req = (self.waiting.popleft() if self.qos is None
                        else self._qos_pop_waiting())
                 self._tier_depth(req, -1)
+            self._flight_note_pop(req)
             ids = req.prompt_ids or [0]
             long = len(ids) > self.buckets[-1]
             lane_full = len(self._long_prefills) >= self._max_long_prefills
@@ -1465,6 +1659,14 @@ class LLMEngine:
                 seq.release()
                 self._release_hit_pin(hit)
                 self.metrics.admission_failures += 1
+                if self.flight.enabled:
+                    # Args materialized only when recording (the PR-7
+                    # reporter idiom: the recorder-less hot path pays
+                    # nothing, not even the perf_counter call).
+                    self.flight.record_event(
+                        EV_ADMIT_RETRY, time.perf_counter(),
+                        rid=req.request_id, tier=tier_id(req),
+                        a=float(req.admission_attempts))
                 # Poison: the prompt (plus one generated token) needs
                 # more pages than the pool HAS (page 0 is the sink) —
                 # no amount of draining or reclaim ever admits it, and
@@ -1511,6 +1713,7 @@ class LLMEngine:
             # the real _Slot replaces the placeholder at dispatch.
             placeholder = _Slot(req, seq, None)
             self.slots[slot_idx] = placeholder
+            self._flight_admit(req, slot_idx)
             if self.qos is not None:
                 # Charge the weighted-fair accounting only for REAL
                 # admissions (deferred/requeued requests go back to the
@@ -1561,6 +1764,9 @@ class LLMEngine:
                       seq: SequencePages) -> None:
         """Fail one request before it reached decodable state: free the
         slot and pages, emit the terminal error event."""
+        slot = self.slots[slot_idx]
+        if slot is not None:
+            self._flight_retire(slot, "error")
         self.slots[slot_idx] = None
         seq.release()
         req.stream.put({"text": "", "token_id": -1, "finished": True,
@@ -1633,6 +1839,11 @@ class LLMEngine:
             self.slots[slot_idx] = slot
             metas.append((slot_idx, slot))
             self.metrics.prefill_tokens += len(ids)
+            if self.flight.enabled:
+                self.flight.record_event(
+                    EV_PREFILL_DISPATCH, time.perf_counter(),
+                    rid=req.request_id, tier=tier_id(req),
+                    slot=slot_idx, a=float(len(ids)))
             # Completed prefill: its full prompt pages become reusable
             # by later identical/shared-prefix prompts (the page writes
             # are already dispatched; device ordering sequences any
@@ -1684,6 +1895,14 @@ class LLMEngine:
         freed = self.prefix_cache.evict(n)
         if freed:
             self.metrics.prefix_evictions += freed
+            if self.kv_pager is not None:
+                # With the pager, eviction DEMOTES instead of
+                # destroying — a page-move record for the timeline.
+                self._beat_kv_demote += freed
+                if self.flight.enabled:
+                    self.flight.record_event(EV_KV_DEMOTE,
+                                             time.perf_counter(),
+                                             a=float(freed))
 
     # graftlint: hot-path
     def _lookup_prefix(self, ids: List[int], promote: bool = True):
@@ -1728,12 +1947,29 @@ class LLMEngine:
             if any(n.tier != TIER_DEVICE for n in nodes):
                 promoted = False
                 if promote:
+                    n_cold = sum(1 for n in nodes
+                                 if n.tier != TIER_DEVICE)
+                    t0 = time.perf_counter()
                     try:
                         self.pool = self.prefix_cache.promote(self.pool,
                                                               nodes)
                         promoted = True
                     except MemoryError:
                         pass  # resident-prefix fallback below
+                    if promoted:
+                        # Page-move record: host-side promote cost per
+                        # page (the gather/scatter dispatch is async;
+                        # this times the host work — tier reads plus
+                        # staging — which is what stalls the beat).
+                        dt_ms = (time.perf_counter() - t0) * 1e3
+                        self.metrics.hists[
+                            "kv_promote_ms_per_page"].observe(
+                            dt_ms / max(1, n_cold))
+                        self._beat_kv_promote += n_cold
+                        if self.flight.enabled:
+                            self.flight.record_event(
+                                EV_KV_PROMOTE, t0, a=float(n_cold),
+                                b=dt_ms)
                 if not promoted:
                     # Not promoting (caller will discard the hit —
                     # scratch lane full — so a device scatter that may
@@ -1888,6 +2124,11 @@ class LLMEngine:
                     logits, lp.cache = res["chunk_logits"], res["cache"]
                     lp.pos += len(part)
                     self.metrics.prefill_tokens += len(part)
+                    if self.flight.enabled:
+                        self.flight.record_event(
+                            EV_PREFILL_CHUNK, time.perf_counter(),
+                            rid=lp.req.request_id,
+                            tier=tier_id(lp.tier), a=float(len(part)))
                     if lp.pos >= len(lp.ids):
                         self._long_prefills.remove(lp)
                         self._finish_long_prefill(lp, logits)
@@ -2237,8 +2478,11 @@ class LLMEngine:
                         b.copy_to_host_async()
                     except AttributeError:
                         pass
-            self._inflight.append(_InFlight(block, metas, K,
-                                            spec_worst=worst))
+            fl = _InFlight(block, metas, K, spec_worst=worst)
+            # plan_step's dispatch-return stamp (engine_model hook).
+            fl.t_dispatch = res.get("t_dispatch") or time.perf_counter()
+            fl.plan = plan
+            self._inflight.append(fl)
         else:
             block = res["block"]
             for i in active:
@@ -2261,8 +2505,10 @@ class LLMEngine:
                     block.copy_to_host_async()
                 except AttributeError:
                     pass
-            self._inflight.append(_InFlight(block, metas, K,
-                                            plain_spec=plan.spec_state))
+            fl = _InFlight(block, metas, K, plain_spec=plan.spec_state)
+            fl.t_dispatch = res.get("t_dispatch") or time.perf_counter()
+            fl.plan = plan
+            self._inflight.append(fl)
         return True
 
     # graftlint: hot-path
@@ -2356,6 +2602,11 @@ class LLMEngine:
             # Real (unpadded) prompt tokens only — the rider's fixed-
             # width padding must not inflate the prefill meter.
             self.metrics.prefill_tokens += len(part)
+            if self.flight.enabled:
+                self.flight.record_event(
+                    EV_PREFILL_CHUNK, time.perf_counter(),
+                    rid=lp.req.request_id, tier=tier_id(lp.tier),
+                    a=float(len(part)), b=1.0)  # b=1: fused rider
             if lp.pos >= len(lp.ids):
                 self._long_prefills.remove(lp)
                 self._finish_long_prefill(lp, res["chunk_logits"])
@@ -2464,6 +2715,7 @@ class LLMEngine:
                     slot.first_emitted = True
                     ttft_ms = (now - slot.req.submit_time) * 1e3
                     self.metrics.record_ttft(ttft_ms)
+                    self._flight_first(slot, ttft_ms)
                     if slot.span is not None:
                         slot.span.add_event("first_token",
                                             {"ttft_ms": round(ttft_ms, 2)})
@@ -2559,6 +2811,7 @@ class LLMEngine:
             slot.first_emitted = True
             ttft_ms = (now - slot.req.submit_time) * 1e3
             self.metrics.record_ttft(ttft_ms)
+            self._flight_first(slot, ttft_ms)
             if slot.span is not None:
                 slot.span.add_event("first_token",
                                     {"ttft_ms": round(ttft_ms, 2)})
@@ -2675,6 +2928,7 @@ class LLMEngine:
         slot = self.slots[slot_idx]
         if slot is None:
             return
+        self._flight_retire(slot, reason)
         self._pace_flush(slot)
         if emit:
             slot.req.stream.put({"text": "", "token_id": -1, "finished": True,

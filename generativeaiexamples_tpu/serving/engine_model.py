@@ -18,6 +18,7 @@ Both are shape-stable: prefill compiles once per bucket, decode once per
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -1288,14 +1289,26 @@ class StepPlan(NamedTuple):
     spec_state: bool = False
 
 
-def plan_step(params, cfg: LlamaConfig, plan: StepPlan, *,
-              pool=None, last_tokens=None, page_tables=None, lengths=None,
-              active=None, temperature=None, top_p=None, top_k=None,
-              rng=None, history=None, dev_lengths=None, cache=None,
-              chunk_tokens=None, chunk_valid=None,
-              use_pallas: Optional[bool] = None,
-              sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
-              mesh=None) -> dict:
+def plan_step(params, cfg: LlamaConfig, plan: StepPlan, **kw) -> dict:
+    """Dispatch-timestamp wrapper over _plan_step: every scheduler
+    dispatch flows through here, so the flight recorder's
+    `t_dispatch` stamp (taken the moment the async jitted call
+    returns, BEFORE the engine folds state back) lives in the result
+    dict as "t_dispatch" — one authoritative hook instead of each
+    call site reading its own clock."""
+    out = _plan_step(params, cfg, plan, **kw)
+    out["t_dispatch"] = time.perf_counter()
+    return out
+
+
+def _plan_step(params, cfg: LlamaConfig, plan: StepPlan, *,
+               pool=None, last_tokens=None, page_tables=None, lengths=None,
+               active=None, temperature=None, top_p=None, top_k=None,
+               rng=None, history=None, dev_lengths=None, cache=None,
+               chunk_tokens=None, chunk_valid=None,
+               use_pallas: Optional[bool] = None,
+               sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
+               mesh=None) -> dict:
     """Lower a StepPlan to ONE jitted device program — the single
     dispatch entry point for every scheduler step. Each lattice point
     maps to exactly one fused program (the plan IS the compile key),

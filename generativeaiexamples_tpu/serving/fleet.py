@@ -71,6 +71,10 @@ _COUNTER_KEYS = (
     "kv_host_pages", "kv_spill_pages", "kv_host_bytes", "kv_spill_bytes",
     "kv_spill_writes", "kv_spill_compactions", "kv_forced_drops",
     "kv_pager_errors",
+    # Flight-recorder counters (serving/flight.py) sum across
+    # replicas; the per-lane rings themselves are served by
+    # /debug/timeline (one Perfetto lane per local replica).
+    "flight_beats", "flight_events",
 )
 
 
@@ -391,18 +395,24 @@ class FleetMetrics:
             for t, v in (snap.get("qos_queue_depth") or {}).items():
                 qd[t] = qd.get(t, 0) + (v or 0)
         out["qos_queue_depth"] = qd
-        # TTFT percentiles merge raw samples (local replicas only —
-        # remote snapshots expose only their own percentiles, kept
-        # under per_replica).
-        samples: List[float] = []
-        for r in self._fleet.local_replicas():
-            with r.engine.metrics._lock:
-                samples.extend(r.engine.metrics.ttft_ms)
-        samples.sort()
-        pct = lambda p: (samples[int(p * (len(samples) - 1))]  # noqa: E731
-                         if samples else None)
-        out["ttft_p50_ms"] = pct(0.5)
-        out["ttft_p95_ms"] = pct(0.95)
+        # Latency histograms merge element-wise across ALL replicas
+        # (local and remote — the snapshots are JSON-shaped either
+        # way; one fixed bucket scheme makes the merge a sum), and the
+        # fleet TTFT percentiles come from the merged histogram — the
+        # always-present contract holds fleet-wide.
+        from generativeaiexamples_tpu.obs.tracing import (
+            trace_export_errors)
+        from generativeaiexamples_tpu.serving import flight as flight_mod
+
+        for k in flight_mod.HIST_KEYS:
+            out[k] = flight_mod.merge_hist_snapshots(
+                [s.get(k) for s in per_replica.values()])
+        out["ttft_p50_ms"] = out["hist_ttft_ms"]["p50"]
+        out["ttft_p95_ms"] = out["hist_ttft_ms"]["p95"]
+        out["flight_enabled"] = max(
+            (int(s.get("flight_enabled") or 0)
+             for s in per_replica.values()), default=0)
+        out["trace_export_errors"] = trace_export_errors()
         out.update(self._fleet.router.snapshot())
         out["per_replica"] = per_replica
         return out
@@ -466,6 +476,13 @@ class EngineFleet:
 
     def local_replicas(self) -> List[LocalReplica]:
         return [r for r in self.replicas if isinstance(r, LocalReplica)]
+
+    def flight_recorders(self) -> Dict[str, Any]:
+        """rid -> FlightRecorder for every local replica — the
+        /debug/timeline lanes (remote replicas serve their own
+        /debug/timeline; their rings cannot cross processes)."""
+        return {r.rid: r.engine.flight for r in self.local_replicas()
+                if getattr(r.engine, "flight", None) is not None}
 
     def submit(self, req):  # graftlint: hot-path
         """Place and dispatch one request. Raises FleetUnavailableError

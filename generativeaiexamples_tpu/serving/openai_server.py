@@ -121,6 +121,7 @@ class OpenAIServer:
             web.post("/v1/embeddings", self.handle_embeddings),
             web.post("/v1/ranking", self.handle_ranking),
             web.get("/metrics", self.handle_metrics),
+            web.get("/debug/timeline", self.handle_timeline),
         ])
 
     # -- helpers -----------------------------------------------------------
@@ -240,6 +241,21 @@ class OpenAIServer:
         fleet_health = getattr(self.llm, "fleet_health", None)
         payload["fleet"] = (fleet_health() if callable(fleet_health)
                             else {"enabled": False, "replicas": {}})
+        # Flight recorder — always present (enabled false, zeros when
+        # the knob is off or the llm object has no recorder): beat and
+        # lifecycle-event counts summed across the lanes this server
+        # fronts, plus where to fetch the timeline itself.
+        lanes = self._flight_lanes()
+        fr_section = {"enabled": False, "flight_beats": 0,
+                      "flight_events": 0, "lanes": len(lanes),
+                      "timeline": "/debug/timeline"}
+        for rec in lanes.values():
+            s = rec.stats()
+            fr_section["enabled"] = (fr_section["enabled"]
+                                     or bool(s["flight_enabled"]))
+            fr_section["flight_beats"] += s["flight_beats"]
+            fr_section["flight_events"] += s["flight_events"]
+        payload["flight_recorder"] = fr_section
         # QoS — always present (enabled false, zeroed counters when the
         # knobs are off): engine-side weighted-fair scheduling +
         # preemption state and the edge's per-tier shed/depth view.
@@ -278,7 +294,40 @@ class OpenAIServer:
         # reads the whole QoS picture: engine tier depths + preemption
         # count from the engine snapshot, shedding from the edge.
         snap.update(self.edge.snapshot())
+        # ?format=prometheus: text exposition (0.0.4) — scalars as
+        # gauges, flat maps labelled, the flight histograms in native
+        # Prometheus histogram form. Default stays JSON.
+        if request.query.get("format") == "prometheus":
+            from generativeaiexamples_tpu.serving.flight import (
+                prometheus_text)
+
+            return web.Response(
+                text=prometheus_text(snap),
+                content_type="text/plain", charset="utf-8",
+                headers={"X-Prometheus-Exposition-Version": "0.0.4"})
         return web.json_response(snap)
+
+    def _flight_lanes(self) -> Dict[str, Any]:
+        """name -> FlightRecorder for every lane this server fronts: a
+        fleet exposes one per local replica, a single engine one."""
+        get = getattr(self.llm, "flight_recorders", None)
+        if callable(get):
+            return get()
+        fr = getattr(self.llm, "flight", None)
+        return {"engine": fr} if fr is not None else {}
+
+    async def handle_timeline(self, request: web.Request) -> web.Response:
+        """Chrome trace-event JSON over the flight-recorder rings
+        (Perfetto / chrome://tracing load the payload directly): one
+        process lane per replica, beat slices + request spans
+        correlated by rid. Built in the executor — a full ring render
+        must not stall live SSE streams."""
+        from generativeaiexamples_tpu.serving.flight import chrome_trace
+
+        loop = asyncio.get_running_loop()
+        trace = await loop.run_in_executor(
+            self._executor, lambda: chrome_trace(self._flight_lanes()))
+        return web.json_response(trace)
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._generate(request, chat=True)

@@ -73,6 +73,15 @@ def request_tier(req) -> str:
     return normalize_tier(getattr(req, "priority", ""))
 
 
+def tier_id(tier_or_req) -> int:
+    """Compact tier tag for fixed-width records (the flight recorder's
+    beat/event rows store tiers as uint8): the tier's index into
+    TIERS. Accepts a tier string or a request object."""
+    tier = (tier_or_req if isinstance(tier_or_req, str)
+            else request_tier(tier_or_req))
+    return TIER_RANK.get(normalize_tier(tier), TIER_RANK[DEFAULT_TIER])
+
+
 class TierScheduler:
     """Weighted-fair admission order for the engine's waiting queue.
 

@@ -44,7 +44,13 @@
 #                   count, warm resume from the host tier is
 #                   byte-identical to never-demoted greedy,
 #                   promotions observed).
-#   9. tier-1 tests — the ROADMAP.md pytest gate.
+#   9. flight smoke — CPU gate for the engine flight recorder
+#                   (scripts/smoke_flight.py: recorder on by default,
+#                   beat records >= decode_steps, recorder-on vs -off
+#                   token streams byte-identical, timeline JSON loads
+#                   and spans nest, analyzer attribution sums ~100%,
+#                   overhead <= 1% on paired bursts).
+#  10. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -91,6 +97,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "KV-pager smoke (JAX_PLATFORMS=cpu scripts/smoke_kv_pager.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_kv_pager.py || fail=1
+
+    step "flight smoke (JAX_PLATFORMS=cpu scripts/smoke_flight.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_flight.py || fail=1
 
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

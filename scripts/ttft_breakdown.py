@@ -2,12 +2,13 @@
 <=200 ms p50 or publish a measured per-stage table).
 
 Boots the deployment-config engine (llama3-8b int8 weights, int8 KV,
-B=128), warms it, then timestamps one request's path through the
-scheduler: submit -> admit (scheduler picks it up) -> prefill dispatch
-returns (async) -> first decode block dispatch returns (async) ->
-host fetch of that block starts/ends -> token emitted. The fetch
-segment is the host<->device readback (~100 ms through the axon
-tunnel; near-zero on direct-attached hosts).
+B=128), warms it, then reads one request's path through the scheduler
+FROM THE FLIGHT RECORDER (serving/flight.py): submit -> admit (slot
+reserved) -> prefill dispatched -> first token emitted. The recorder
+is always on, so this script no longer monkeypatches scheduler
+internals — the same stage table works on any engine config (fused,
+speculative, prefix-cached), and `/debug/timeline` shows the same
+requests as Perfetto spans.
 
 Usage: python scripts/ttft_breakdown.py [n_requests]
 Prints one stage table per request plus the median summary row for
@@ -26,12 +27,52 @@ from generativeaiexamples_tpu.utils.platform import apply_platform_env
 apply_platform_env()
 
 import jax  # noqa: E402
-import numpy as np  # noqa: E402
 
 from generativeaiexamples_tpu.config.schema import EngineConfig  # noqa: E402
 from generativeaiexamples_tpu.models import llama  # noqa: E402
-from generativeaiexamples_tpu.serving.engine import LLMEngine  # noqa: E402
+from generativeaiexamples_tpu.serving import flight  # noqa: E402
+from generativeaiexamples_tpu.serving.engine import (  # noqa: E402
+    GenRequest, LLMEngine)
 from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer  # noqa: E402
+
+STAGES = ["admit", "prefill_dispatched", "first_token"]
+_STAGE_KINDS = {
+    flight.EV_ADMIT: "admit",
+    flight.EV_PREFILL_DISPATCH: "prefill_dispatched",
+    # Chunked/prefix-hit prompts dispatch chunks instead of one group;
+    # the FIRST chunk marks the same "prefill started" stage.
+    flight.EV_PREFILL_CHUNK: "prefill_dispatched",
+    flight.EV_FIRST_TOKEN: "first_token",
+}
+
+
+def stage_rows(recorder, rids):
+    """Per-request stage tables (ms from submit) read from the
+    recorder's lifecycle ring."""
+    by_rid = {}
+    for ev in recorder.snapshot_events():
+        by_rid.setdefault(ev["rid"], []).append(ev)
+    rows = []
+    for rid in rids:
+        evs = by_rid.get(rid, [])
+        submit = next((e["ts"] for e in evs
+                       if e["kind"] == flight.EV_SUBMIT), None)
+        if submit is None:
+            rows.append({})
+            continue
+        row = {}
+        prev = submit
+        for stage in STAGES:
+            ts = next((e["ts"] for e in evs
+                       if _STAGE_KINDS.get(e["kind"]) == stage), None)
+            if ts is not None:
+                row[stage] = (ts - prev) * 1e3
+                prev = ts
+        last = next((e["ts"] for e in evs
+                     if e["kind"] == flight.EV_FIRST_TOKEN), prev)
+        row["total"] = (last - submit) * 1e3
+        rows.append(row)
+    return rows
 
 
 def main() -> None:
@@ -53,62 +94,29 @@ def main() -> None:
     list(eng.generate_stream(prompt, max_new_tokens=4))  # e2e warm
     print("[ttft] engine warm", file=sys.stderr)
 
-    marks = {}
-
-    orig_prefill = eng._prefill_group
-    orig_dispatch = eng._dispatch_decode
-    orig_first = eng._emit_first_values
-
-    def prefill_group(bucket, entries):
-        marks.setdefault("admit", time.perf_counter())
-        out = orig_prefill(bucket, entries)
-        marks.setdefault("prefill_dispatched", time.perf_counter())
-        return out
-
-    def dispatch_decode():
-        out = orig_dispatch()
-        if "prefill_dispatched" in marks:
-            marks.setdefault("decode_dispatched", time.perf_counter())
-        return out
-
-    # r4: the first token is emitted from the async copy of the
-    # prefill-sampled tokens (engine._emit_ready_first_tokens), not
-    # from a decode-block fetch — emit_first is the stage to watch.
-
-    def emit_first(vals, metas):
-        if "prefill_dispatched" in marks:
-            marks.setdefault("emit_first", time.perf_counter())
-        return orig_first(vals, metas)
-
-    eng._prefill_group = prefill_group
-    eng._dispatch_decode = dispatch_decode
-    eng._emit_first_values = emit_first
-
-    stages = ["admit", "prefill_dispatched", "decode_dispatched",
-              "emit_first", "first_token"]
-    rows = []
+    rids = []
     for r in range(n_req):
-        marks.clear()
-        t0 = time.perf_counter()
-        for ev in eng.generate_stream(prompt, max_new_tokens=2):
-            if ev["token_id"] >= 0:
-                marks.setdefault("first_token", time.perf_counter())
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=2,
+                         request_id=f"ttft-{r}")
+        rids.append(req.request_id)
+        eng.submit(req)
+        while True:
+            ev = req.stream.get()
+            if ev["token_id"] >= 0 or ev["finished"]:
                 break
-        row = {}
-        prev = t0
-        for s in stages:
-            if s in marks:
-                row[s] = (marks[s] - prev) * 1e3
-                prev = marks[s]
-        row["total"] = (marks.get("first_token", prev) - t0) * 1e3
-        rows.append(row)
-        print(f"[ttft] req {r}: " + "  ".join(
-            f"{s}={row.get(s, float('nan')):.1f}ms" for s in stages + ["total"]))
+        # Drain the stream so the next request sees an idle engine.
+        while not ev["finished"]:
+            ev = req.stream.get()
         time.sleep(0.2)
+    rows = stage_rows(eng.flight, rids)
     eng.stop()
 
+    for r, row in enumerate(rows):
+        print(f"[ttft] req {r}: " + "  ".join(
+            f"{s}={row.get(s, float('nan')):.1f}ms"
+            for s in STAGES + ["total"]))
     med = {s: statistics.median([r[s] for r in rows if s in r])
-           for s in stages + ["total"] if any(s in r for r in rows)}
+           for s in STAGES + ["total"] if any(s in r for r in rows)}
     print("[ttft] MEDIAN  " + "  ".join(f"{s}={v:.1f}ms"
                                         for s, v in med.items()))
 

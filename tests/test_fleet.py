@@ -497,6 +497,62 @@ class TestCounterSurfaces:
         assert snap["router_requests"] == 0
         assert snap["tokens_generated"] == 0
 
+    def test_fleet_merges_histograms_and_flight_counters(self, params):
+        """Fleet aggregation of the flight surface: hist_* keys merge
+        element-wise across replicas (fleet TTFT percentiles come from
+        the MERGED histogram), flight_beats/events sum, and every key
+        is present even when idle."""
+        from generativeaiexamples_tpu.serving import flight as flight_mod
+
+        fleet, engines = make_fleet(params)
+        try:
+            # Distinct sessions so both replicas serve traffic.
+            for i in range(4):
+                run_one(fleet, [3 + i, 5, 7, 9], session=f"s{i}",
+                        max_new=4)
+            # Quiesce: pipelined blocks can still land AFTER the last
+            # stream's terminal event — the fleet-vs-replica sum
+            # comparison below needs both sides frozen.
+            deadline = time.monotonic() + 30
+            while any(e._inflight or any(s is not None for s in e.slots)
+                      for e in engines):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            time.sleep(0.05)
+            snap = fleet.metrics.snapshot()
+            for key in flight_mod.HIST_KEYS:
+                assert "count" in snap[key] and "buckets" in snap[key]
+            per = [engines[0].metrics.snapshot(),
+                   engines[1].metrics.snapshot()]
+            assert snap["hist_ttft_ms"]["count"] == \
+                sum(s["hist_ttft_ms"]["count"] for s in per) == 4
+            assert snap["flight_beats"] == \
+                sum(s["flight_beats"] for s in per) > 0
+            assert snap["flight_events"] == \
+                sum(s["flight_events"] for s in per)
+            assert snap["flight_enabled"] == 1
+            assert snap["ttft_p50_ms"] is not None
+            # Process-global monotonic counter (other tests exercise
+            # tracing failure paths in-process): present, not zero.
+            assert snap["trace_export_errors"] >= 0
+            # The fleet's /debug/timeline lanes: one per local replica.
+            recs = fleet.flight_recorders()
+            assert set(recs) == {"r0", "r1"}
+            trace = flight_mod.chrome_trace(recs)
+            assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+        finally:
+            fleet.stop()
+
+    def test_fleet_hist_merge_tolerates_missing_keys(self):
+        """Remote replicas that predate the histogram surface (or
+        error snapshots) contribute nothing instead of crashing."""
+        fleet = EngineFleet([FakeReplica("r0"), FakeReplica("r1")],
+                            ByteTokenizer(), PS)
+        snap = fleet.metrics.snapshot()
+        assert snap["hist_ttft_ms"]["count"] == 0
+        assert snap["ttft_p50_ms"] is None
+        assert snap["flight_beats"] == 0
+
     def test_sse_event_parser(self):
         lines = [
             b'data: {"choices": [{"text": "he", "finish_reason": null}]}\n',

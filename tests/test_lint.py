@@ -363,6 +363,50 @@ METRICS_CLEAN = """\
             self.stats.lost += 1
 """
 
+# GL601 over a histogram-shaped class (the serving/flight.py
+# ExpHistogram idiom): observe() increments count/total per sample
+# alongside the bucket array; the BAD twin's snapshot() surfaces the
+# buckets but silently drops `overflowed` — a counter that can never
+# reach /metrics. The clean twin reads every incremented attr.
+HIST_METRICS_BAD = """\
+    class Hist:
+        def __init__(self):
+            self.counts = [0] * 8
+            self.count = 0
+            self.total = 0.0
+            self.overflowed = 0
+
+        def observe(self, v):
+            if v > 100:
+                self.overflowed += 1
+            self.count += 1
+            self.total += v
+
+        def snapshot(self):
+            return {"count": self.count, "sum": self.total,
+                    "buckets": list(self.counts)}
+"""
+
+HIST_METRICS_CLEAN = """\
+    class Hist:
+        def __init__(self):
+            self.counts = [0] * 8
+            self.count = 0
+            self.total = 0.0
+            self.overflowed = 0
+
+        def observe(self, v):
+            if v > 100:
+                self.overflowed += 1
+            self.count += 1
+            self.total += v
+
+        def snapshot(self):
+            return {"count": self.count, "sum": self.total,
+                    "overflow": self.overflowed,
+                    "buckets": list(self.counts)}
+"""
+
 # GL502: save() rewrites the artifact in place; the clean twin stages
 # through a tmp name and os.replace()s it into place. `_write_rows` is
 # only a sink because its CALLER provably works under persist_dir.
@@ -657,6 +701,21 @@ class TestMetricsContract:
         # counts), `lost` as a literal key.
         findings = lint_paths([write_tree(tmp_path,
                                           {"mod.py": METRICS_CLEAN})])
+        assert ids_of(findings) == set()
+
+    def test_fires_on_unsurfaced_histogram_counter(self, tmp_path):
+        # The flight-recorder histogram idiom: per-sample counters
+        # incremented in observe() are under the same contract as any
+        # scheduler counter — dropping one from snapshot() fires.
+        findings = lint_paths([write_tree(tmp_path,
+                                          {"mod.py": HIST_METRICS_BAD})])
+        gl601 = [f for f in findings if f.check == "GL601"]
+        assert len(gl601) == 1  # count/total surfaced -> quiet
+        assert "overflowed" in gl601[0].message
+
+    def test_quiet_on_fully_surfaced_histogram(self, tmp_path):
+        findings = lint_paths([write_tree(
+            tmp_path, {"mod.py": HIST_METRICS_CLEAN})])
         assert ids_of(findings) == set()
 
     def test_functional_state_exempt(self, tmp_path):

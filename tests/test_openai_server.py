@@ -208,6 +208,106 @@ def test_health_kv_pager_section_with_pager_enabled():
     assert h["kv_pager"]["kv_spill_pages"] == 2
 
 
+def test_flight_and_histogram_surfaces_always_present(server):
+    """The flight-recorder/histogram surface follows the always-
+    present convention: /metrics carries flight_* counters and every
+    hist_* key (empty-but-present dicts when idle), /health carries a
+    flight_recorder section, and trace_export_errors exists."""
+    from generativeaiexamples_tpu.serving.flight import (
+        FLIGHT_KEYS, HIST_KEYS)
+
+    async def body(c):
+        h = await (await c.get("/health")).json()
+        m = await (await c.get("/metrics")).json()
+        return h, m
+
+    h, m = _client_call(server, body)
+    for key in FLIGHT_KEYS:
+        assert key in m
+    assert m["flight_enabled"] == 1  # recorder defaults ON
+    assert m["flight_beats"] >= 0
+    for key in HIST_KEYS:
+        assert "count" in m[key] and "buckets" in m[key]
+    # Process-global monotonic counter (other tests exercise failure
+    # paths in the same process): present and sane, not necessarily 0.
+    assert isinstance(m["trace_export_errors"], int)
+    assert m["trace_export_errors"] >= 0
+    fr = h["flight_recorder"]
+    assert fr["enabled"] is True
+    assert fr["timeline"] == "/debug/timeline"
+    assert fr["lanes"] == 1
+
+
+def test_flight_section_enabled_false_without_recorder():
+    """A recorder-less llm object (or flight_recorder=False engines
+    behind a facade) still gets the /health section — enabled false,
+    zeros, never absent."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    class _Metrics:
+        def snapshot(self):
+            return {}
+
+    class _LLM:
+        metrics = _Metrics()
+
+    async def runner():
+        srv = OpenAIServer(_LLM())
+        client = TestClient(TestServer(srv.app))
+        await client.start_server()
+        try:
+            h = await (await client.get("/health")).json()
+            t = await (await client.get("/debug/timeline")).json()
+            return h, t
+        finally:
+            await client.close()
+
+    h, t = asyncio.run(runner())
+    assert h["flight_recorder"] == {
+        "enabled": False, "flight_beats": 0, "flight_events": 0,
+        "lanes": 0, "timeline": "/debug/timeline"}
+    assert t == {"traceEvents": [], "displayTimeUnit": "ms"}
+
+
+def test_metrics_prometheus_format(server):
+    """?format=prometheus serves text exposition: gauges for scalars,
+    labelled gauges for tier maps, native histogram lines for the
+    hist_* keys; default stays JSON."""
+    async def body(c):
+        # Serve one request so counters are nonzero.
+        await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3})
+        r = await c.get("/metrics", params={"format": "prometheus"})
+        return r.headers["Content-Type"], await r.text()
+
+    ctype, txt = _client_call(server, body)
+    assert ctype.startswith("text/plain")
+    assert "# TYPE gaie_tokens_generated gauge" in txt
+    assert "# TYPE gaie_ttft_ms histogram" in txt
+    assert 'gaie_ttft_ms_bucket{le="+Inf"}' in txt
+    assert 'gaie_qos_queue_depth{key="latency"}' in txt
+    assert "gaie_flight_beats" in txt
+
+
+def test_debug_timeline_endpoint(server):
+    """/debug/timeline serves Chrome trace JSON whose request spans
+    carry the server-issued rid."""
+    async def body(c):
+        r = await c.post("/v1/chat/completions", json={
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4})
+        data = await r.json()
+        t = await (await c.get("/debug/timeline")).json()
+        return data["id"], t
+
+    rid, trace = _client_call(server, body)
+    evs = trace["traceEvents"]
+    assert any(e.get("cat") == "beat" for e in evs)
+    assert any(e.get("cat") == "request"
+               and e.get("args", {}).get("rid") == rid for e in evs)
+
+
 def test_fleet_server_streams_and_health(server):
     """An OpenAIServer whose llm object IS a fleet: streaming works
     through the router unchanged, /health carries replica states, and

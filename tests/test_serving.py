@@ -296,7 +296,7 @@ class TestSchedulerLatency:
             assert eng.metrics.decode_steps == 1
             # ... and exactly one TTFT sample was recorded (the early
             # async path and the block path must not double-count).
-            assert len(eng.metrics.ttft_ms) == 1
+            assert eng.metrics.hists["ttft_ms"].count == 1
         finally:
             eng.stop()
 
