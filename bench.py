@@ -34,7 +34,11 @@ pinned to the CPU backend, see scripts/bench_fleet.py),
 BENCH_QOS (0 skips; BENCH_QOS_SEED / _HORIZON_S / _BATCH_REQUESTS /
 _LATENCY_RPS / _SLO_TTFT_MS tune the replayed bursty multi-tenant
 trace and the latency-tier SLO — also a CPU-backend child process,
-see scripts/bench_qos.py).
+see scripts/bench_qos.py),
+BENCH_CHAOS (0 skips; BENCH_CHAOS_SEED / _HORIZON_S /
+_BATCH_REQUESTS / _LATENCY_RPS / _SLO_TTFT_MS / _KILL_T tune the
+replayed trace, the SLO, and when the replica kill fires — a
+CPU-backend child process, see scripts/bench_chaos.py).
 
 Flags: --repeat N runs the headline decode burst N times and reports
 the MEDIAN as the headline value, with per-run values and spread under
@@ -150,13 +154,35 @@ Scenario output keys (under "extras"):
                  (scripts/bench_qos.py) — it measures scheduling
                  policy under wall-clock arrivals, not chip speed.
                  BENCH_QOS=0 skips)
+  chaos / elastic fleet: chaos_goodput_baseline, chaos_goodput_kill,
+                 chaos_kill_goodput_ratio (the goodput FLOOR gate:
+                 >= 0.9 with a replica killed mid-burst),
+                 chaos_kill_lost (must be 0 — every non-mid-stream
+                 request survives via requeue), chaos_kill_midstream,
+                 chaos_kill_requeued, chaos_upgrade_failed_streams /
+                 chaos_upgrade_errors (must be 0 — a rolling engine
+                 upgrade across the fleet drops nothing),
+                 chaos_upgrade_replicas_rolled, chaos_upgrade_wall_s,
+                 chaos_upgrade_goodput, chaos_upgrade_rolls,
+                 chaos_scaleup_events, chaos_scaleup_goodput,
+                 chaos_scaleup_active_after,
+                 chaos_timeline_fleet_events, chaos_trace_requests,
+                 chaos_slo_ttft_ms (the same seeded bursty trace
+                 replayed through a 2-replica fleet with seeded fault
+                 injection — serving/chaos.py kill mid-burst,
+                 EngineFleet.rolling_upgrade under live traffic, and
+                 a 1-replica fleet + serving/autoscaler.py under a
+                 sustained burst, scale events visible on the
+                 /debug/timeline control lanes. CPU-backend child
+                 (scripts/bench_chaos.py). BENCH_CHAOS=0 skips)
 
 `python bench.py --help` prints this header and exits.
 
 Sibling tooling (same checkout):
   scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_tiered_ann.py /
   smoke_microbatch.py / smoke_fused_step.py / smoke_plan_step.py /
-  smoke_router.py / smoke_kv_pager.py / smoke_flight.py
+  smoke_router.py / smoke_kv_pager.py / smoke_flight.py /
+  smoke_chaos.py
       targeted CPU smoke gates for the serving subsystems
   scripts/analyze_timeline.py build/timeline_fused.json
       stall attribution over a /debug/timeline (or bench) artifact:
@@ -645,6 +671,18 @@ def main() -> None:
         except Exception as e:
             qos_stats = {"qos_error": f"{type(e).__name__}: {e}"}
 
+    # -- chaos / elastic fleet (ISSUE 13 tentpole — the operational
+    # gate): the seeded bursty trace through a fleet that loses a
+    # replica mid-burst, rolls an engine upgrade under live traffic,
+    # and autoscales under a sustained burst; goodput floor + zero
+    # lost/failed streams. CPU-backend child like fleet/QoS.
+    chaos_stats = {}
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        try:
+            chaos_stats = _bench_chaos()
+        except Exception as e:
+            chaos_stats = {"chaos_error": f"{type(e).__name__}: {e}"}
+
     tps = statistics.median(tps_runs)
     out = {
         "metric": f"decode_tokens_per_sec_per_chip_llama3_{model}"
@@ -690,6 +728,7 @@ def main() -> None:
             **concurrent_stats,
             **fleet_stats,
             **qos_stats,
+            **chaos_stats,
         },
     }
     # Provenance is pinned: the scenario refuses to emit an artifact
@@ -714,6 +753,12 @@ def _bench_qos():
     """Spawn scripts/bench_qos.py on the CPU backend and merge its
     one-line JSON result (BENCH_QOS_* env knobs pass through)."""
     return _cpu_child_scenario("bench_qos.py", "qos_error")
+
+
+def _bench_chaos():
+    """Spawn scripts/bench_chaos.py on the CPU backend and merge its
+    one-line JSON result (BENCH_CHAOS_* env knobs pass through)."""
+    return _cpu_child_scenario("bench_chaos.py", "chaos_error")
 
 
 def _cpu_child_scenario(script_name: str, error_key: str):

@@ -453,6 +453,47 @@ class FleetConfig:
     # Health-probe period for the background prober; 0 disables the
     # thread (check_health() can still be called explicitly).
     health_interval_s: float = 10.0
+    # Consecutive failed probes before a replica is evicted (any
+    # success resets the count): one slow poll must never kill a
+    # loaded replica.
+    health_fail_threshold: int = 3
+    # Remote replicas' health probes get their OWN short connect/read
+    # deadline (NOT the 300 s stream timeout), backed off up to 3x
+    # with consecutive failures.
+    probe_timeout_s: float = 2.0
+    # -- elastic autoscaler (serving/autoscaler.py). Off by default:
+    # the static fleet is byte-identical with autoscale=False.
+    autoscale: bool = False
+    # Admitting-replica bounds. min_replicas is the always-hot floor
+    # for latency traffic; max_replicas caps spawn growth.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    # Pre-warmed, non-admitting spares kept for instant scale-up.
+    autoscale_warm_pool: int = 1
+    # Control-loop poll period.
+    autoscale_interval_s: float = 2.0
+    # Tier-weighted in-flight requests PER ACTIVE REPLICA above which
+    # the loop wants to scale up / below which it wants to scale down
+    # (the hysteresis band lives between the two).
+    autoscale_up_depth: float = 8.0
+    autoscale_down_depth: float = 1.0
+    # Consecutive over/under-threshold polls required before acting —
+    # an oscillating signal resets both counters (no flapping).
+    autoscale_up_ticks: int = 2
+    autoscale_down_ticks: int = 5
+    # Minimum seconds between ANY two scale actions.
+    autoscale_cooldown_s: float = 20.0
+    # Allow a fully idle fleet to park its last replica (batch-tier
+    # scale-to-zero); arriving demand wakes one replica instead of
+    # getting a 503.
+    autoscale_scale_to_zero: bool = False
+    # -- chaos harness (serving/chaos.py). Off by default; on, the
+    # fleet carries an armed ChaosMonkey (live chaos_injected_*
+    # counters, a "chaos" /debug/timeline lane) for fault drills —
+    # injections themselves still only fire when a schedule runs.
+    chaos: bool = False
+    # Seed for the monkey's replica picks: same seed, same targets.
+    chaos_seed: int = 0
 
 
 @dataclass(frozen=True)

@@ -57,6 +57,11 @@ HOT_ROOTS: Dict[str, Set[str]] = {
     "fleet.py": {"submit"},      # fleet dispatch + stream hooks
     "qos.py": {"pick"},          # weighted-fair pop under the waiting lock
     "tiered.py": {"search"},     # tiered-ANN dispatch + host refine/merge
+    # Autoscaler decision path: tick() runs every poll AND its wake
+    # path rides the submit hot path (EngineFleet.submit calls
+    # wake_for_submit on an empty fleet), so a host sync creeping in
+    # would stall live placements.
+    "autoscaler.py": {"tick", "wake_for_submit"},
 }
 DEVICE_NAME_RE = re.compile(r"(^|_)dev(_|$)|device", re.IGNORECASE)
 NUMPY_MODULES = ("np", "numpy", "onp")

@@ -101,7 +101,13 @@ def build_engines(cfg, model_size: str = "tiny"):
 
         engines = [LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh)
                    for _ in range(n_replicas)]
-        llm = build_fleet(cfg, engines=engines, tokenizer=tokenizer)
+        # Autoscaler spawn lane: new replicas share the (read-only)
+        # params and the module-level jitted steps, so a spawn costs
+        # engine state only, not a recompile.
+        llm = build_fleet(
+            cfg, engines=engines, tokenizer=tokenizer,
+            engine_factory=lambda: LLMEngine(params, lcfg, tokenizer,
+                                             cfg.engine, mesh=mesh))
     else:
         llm = LLMEngine(params, lcfg, tokenizer, cfg.engine, mesh=mesh)
     if os.environ.get("ENGINE_WARMUP", "1") != "0":

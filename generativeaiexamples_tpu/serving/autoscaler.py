@@ -1,0 +1,370 @@
+"""SLO-guarded fleet autoscaler: the elastic control loop over
+EngineFleet (ROADMAP item 5 — a fleet that changes size underneath
+live traffic while the goodput gate holds).
+
+A background controller polls the fleet's cheap load signals — the
+router's per-replica, per-tier in-flight depths (the same
+`router_tier_depth` surface /metrics exports), tier-weighted exactly
+like placement scoring (serving/qos.py TIER_LOAD_WEIGHT, so queued
+latency-tier requests push the scaler twice as hard as batch backlog)
+— and drives the fleet's own topology verbs:
+
+- **scale up**: sustained pressure above `up_depth` weighted requests
+  per active replica first WAKES a warm-pool replica
+  (`fleet.restore`, instant — the engine is already started and
+  warmed), then falls back to SPAWNING a fresh one via
+  `engine_factory` (bounded by `max_replicas`).
+- **scale down**: sustained pressure below `down_depth` drains the
+  least-loaded active replica into the warm pool (`fleet.park`,
+  engine kept running); replicas beyond the `warm_pool` target are
+  parked COLD (engine stopped — scale-to-zero of the spare capacity).
+- **scale to zero**: with `scale_to_zero=True` and a fully idle
+  signal, even the last active replica parks; demand wakes the fleet
+  back up through `wake_for_submit` (EngineFleet.submit calls it
+  instead of 503ing), so an all-batch workload pays a warm-restore
+  on the first arrival instead of holding an idle replica hot. The
+  latency-tier posture is the opposite: `min_replicas` (default >=1)
+  keeps an admitting replica hot at all times, and the warm pool is
+  the burst headroom.
+
+Thrash control is structural, not tuned: scale-up needs `up_ticks`
+CONSECUTIVE over-threshold polls, scale-down `down_ticks` consecutive
+under-threshold polls (an oscillating signal resets both counters),
+and every action arms a shared `cooldown_s` during which no further
+action fires. `tick(now=...)` is a pure decision step over an
+injectable clock/signal, so hysteresis is unit-testable without
+threads or engines (tests/test_autoscaler.py).
+
+Every decision lands in the controller's OWN flight-recorder lane
+(single-writer: this thread; registered in fleet.extra_flight_lanes)
+so /debug/timeline and scripts/analyze_timeline.py can line a TTFT
+spike up with the scale event that caused it, and in the always-
+present `autoscale_ups/downs/wakes` counters (fleet.FleetOps —
+machine-checked by graftlint GL601).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from generativeaiexamples_tpu.serving.flight import (
+    EV_SCALE_DOWN, EV_SCALE_UP, EV_SCALE_WAKE, FlightRecorder)
+from generativeaiexamples_tpu.serving.fleet import LocalReplica
+from generativeaiexamples_tpu.serving.qos import TIER_LOAD_WEIGHT
+
+_LOG = logging.getLogger(__name__)
+
+# Replica states the scaler may wake (restore) for demand, in
+# preference order: a warm spare restores instantly (engine already
+# running + warmed), a cold-parked one pays an engine restart.
+# Deliberately NOT included: "drained" (an operator drain or a
+# rolling upgrade owns that replica — restoring it would restart an
+# engine the upgrade path just joined), "draining", "evicted".
+_WAKEABLE = ("warm", "parked")
+
+
+class FleetAutoscaler:
+    """Elastic controller for an EngineFleet (attaches itself).
+
+    `signal_fn` (tests): overrides the pressure probe; must return
+    (weighted_depth_total, active_replica_count).
+    """
+
+    def __init__(self, fleet, engine_factory: Optional[Callable] = None,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 warm_pool: int = 1, interval_s: float = 2.0,
+                 up_depth: float = 8.0, down_depth: float = 1.0,
+                 up_ticks: int = 2, down_ticks: int = 5,
+                 cooldown_s: float = 20.0, scale_to_zero: bool = False,
+                 drain_timeout_s: float = 30.0,
+                 signal_fn: Optional[Callable] = None):
+        self.fleet = fleet
+        self.engine_factory = engine_factory
+        self.min_replicas = max(0, int(min_replicas))
+        self.max_replicas = max(1, int(max_replicas))
+        self.warm_pool = max(0, int(warm_pool))
+        self.interval_s = max(0.05, float(interval_s))
+        self.up_depth = float(up_depth)
+        self.down_depth = float(down_depth)
+        self.up_ticks = max(1, int(up_ticks))
+        self.down_ticks = max(1, int(down_ticks))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.scale_to_zero = bool(scale_to_zero)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._signal_fn = signal_fn
+        # Decision state (all under _lock; wake_for_submit races tick).
+        self._lock = threading.Lock()
+        self._above = 0
+        self._below = 0
+        self._last_action_t = float("-inf")
+        self._spawned = 0
+        self._last_decision = "init"
+        # Wake notes from submit threads, drained into the flight lane
+        # by the NEXT tick so the recorder stays single-writer (the
+        # router-report deque idiom: append is thread-safe, the tick
+        # thread is the only consumer).
+        self._pending_wakes: deque = deque()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.flight = FlightRecorder(ring_size=64)
+        fleet.extra_flight_lanes["autoscaler"] = self.flight
+        fleet.attach_autoscaler(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._ensure_warm_pool()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # Same contract as engine/fleet stop: counted, never
+                # silently dropped.
+                _LOG.warning("autoscaler thread still alive after "
+                             "join timeout")
+                self.fleet.ops.note_stuck_join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # Never silent (GL302): a sick control loop must show
+                # up in the log, and must not die of one bad poll.
+                _LOG.exception("autoscaler tick failed")
+
+    # -- signal ------------------------------------------------------------
+
+    def _signal(self):
+        """(tier-weighted in-flight depth across active replicas,
+        active replica count). Cheap: one router lock, no engine or
+        HTTP touches."""
+        if self._signal_fn is not None:
+            return self._signal_fn()
+        depths = self.fleet.router.tier_queue_depths()
+        active = [r for r in self.fleet.replicas if r.state == "active"]
+        total = 0.0
+        for r in active:
+            for tier, n in depths.get(r.rid, {}).items():
+                total += n * TIER_LOAD_WEIGHT.get(tier, 1)
+        return total, len(active)
+
+    # -- the decision step (unit-testable: injected clock + signal) --------
+
+    def tick(self, now: Optional[float] = None) -> str:
+        """One control-loop pass. Returns the decision taken
+        ("up" | "down" | "hold"), for tests and logs.
+
+        Takes the decision lock only around the counter math — the
+        actions themselves run unlocked, because a scale-down drains
+        (blocking up to drain_timeout_s) and a spawn builds an
+        engine, and both wake_for_submit (the submit hot path) and
+        health() (/health) need the same lock meanwhile."""
+        now = time.monotonic() if now is None else now
+        self._drain_wake_notes()
+        total, active = self._signal()
+        pressure = total / max(1, active)
+        with self._lock:
+            if active > 0 and pressure >= self.up_depth:
+                self._above += 1
+                self._below = 0
+            elif total == 0 or pressure <= self.down_depth:
+                self._below += 1
+                self._above = 0
+            else:
+                # Mid-band: hysteresis demands CONSECUTIVE evidence.
+                self._above = 0
+                self._below = 0
+            # A fully parked fleet under any demand at all must wake
+            # even though pressure/active is degenerate.
+            wants_up = (self._above >= self.up_ticks
+                        or (active == 0 and total > 0))
+            in_cooldown = now - self._last_action_t < self.cooldown_s
+            action = "hold"
+            if wants_up and not in_cooldown:
+                action = "up"
+            elif (self._below >= self.down_ticks and not in_cooldown
+                  and active > self._floor(total)):
+                action = "down"
+        decision = "hold"
+        if action == "up" and self._scale_up(now, active):
+            decision = "up"
+        elif action == "down" and self._scale_down(now, active):
+            decision = "down"
+        with self._lock:
+            self._last_decision = decision
+        return decision
+
+    def _floor(self, total_depth: float) -> int:
+        """Minimum admitting replicas right now: min_replicas, except
+        a fully idle fleet with scale_to_zero may park everything
+        (demand wakes it via wake_for_submit)."""
+        if self.scale_to_zero and total_depth == 0:
+            return 0
+        return max(1, self.min_replicas)
+
+    # -- actions (tick thread; take the lock only for fast state) ----------
+
+    def _pick_spare(self):
+        """Best wakeable spare: warm (instant) before cold-parked
+        (engine restart). Caller holds the lock."""
+        cands = [r for r in self.fleet.replicas if r.state in _WAKEABLE]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (_WAKEABLE.index(r.state), r.rid))
+
+    def _scale_up(self, now: float, active: int) -> bool:
+        """Wake a warm spare (fast — pick + restore under the lock,
+        so a racing wake_for_submit cannot grab the same one) or
+        spawn a replica (slow — the engine build runs unlocked)."""
+        with self._lock:
+            cand = self._pick_spare()
+            if cand is not None:
+                self.fleet.restore(cand.rid)
+                rid = cand.rid
+            elif (self.engine_factory is not None
+                  and len(self.fleet.replicas) < self.max_replicas):
+                rid = None
+            else:
+                return False
+            # Reserve the action window up front: even a spawn that
+            # fails consumed this cooldown (no hot-looping a broken
+            # factory).
+            self._above = 0
+            self._last_action_t = now
+        if rid is None:
+            rid = self._spawn(admitting=True)
+            if rid is None:
+                return False
+        self.fleet.ops.note_scale_up()
+        self.flight.record_event(EV_SCALE_UP, time.perf_counter(),
+                                 aux=rid, a=float(active + 1))
+        _LOG.info("autoscale up: %s (active %d -> %d)", rid, active,
+                  active + 1)
+        return True
+
+    def _scale_down(self, now: float, active: int) -> bool:
+        """Drain the least-loaded active replica into the warm pool
+        (cold past the pool target). The drain blocks up to
+        drain_timeout_s and runs UNLOCKED — the victim leaves the
+        wakeable states the moment park() starts draining it, so a
+        racing wake cannot pick it, and health()/wake_for_submit stay
+        responsive throughout."""
+        with self._lock:
+            depths = self.fleet.router.queue_depths()
+            actives = [r for r in self.fleet.replicas
+                       if r.state == "active"]
+            if not actives:
+                return False
+            victim = min(actives,
+                         key=lambda r: (depths.get(r.rid, 0), r.rid))
+            cold = sum(1 for r in self.fleet.replicas
+                       if r.state == "warm") >= self.warm_pool
+            # Reserve the window before the blocking drain (a failed
+            # park consumed its shot; retry after cooldown).
+            self._below = 0
+            self._last_action_t = now
+        if not self.fleet.park(victim.rid, timeout_s=self.drain_timeout_s,
+                               cold=cold):
+            return False  # drain didn't empty: replica was re-admitted
+        self.fleet.ops.note_scale_down()
+        self.flight.record_event(EV_SCALE_DOWN, time.perf_counter(),
+                                 aux=victim.rid, a=float(active - 1),
+                                 b=1.0 if cold else 0.0)
+        _LOG.info("autoscale down: parked %s %s (active %d -> %d)",
+                  victim.rid, "cold" if cold else "warm", active,
+                  active - 1)
+        return True
+
+    def _spawn(self, admitting: bool) -> Optional[str]:
+        """Build + register a fresh local replica (engine_factory
+        path). Runs on the controller thread OUTSIDE the decision
+        lock — spawning is the slow scale-up lane, waking the warm
+        pool the fast one."""
+        try:
+            engine = self.engine_factory()
+        except Exception:
+            _LOG.exception("autoscaler engine_factory failed")
+            return None
+        with self._lock:
+            self._spawned += 1
+            rid = f"as{self._spawned}"
+        replica = LocalReplica(rid, engine)
+        replica.start()
+        self.fleet.add_replica(replica, admitting=admitting)
+        return rid
+
+    def _ensure_warm_pool(self) -> None:
+        """Pre-warm the configured pool at start(): spawn parked-warm
+        replicas until `warm_pool` non-active spares exist (needs an
+        engine_factory and max_replicas headroom)."""
+        if self.engine_factory is None:
+            return
+        while True:
+            with self._lock:
+                spares = sum(1 for r in self.fleet.replicas
+                             if r.state == "warm")
+                if (spares >= self.warm_pool
+                        or len(self.fleet.replicas) >= self.max_replicas):
+                    return
+            if self._spawn(admitting=False) is None:
+                return
+
+    # -- demand wake (server request threads) ------------------------------
+
+    def wake_for_submit(self) -> bool:
+        """Called by EngineFleet.submit when NO replica admits: restore
+        one parked/warm replica for the demand that just arrived.
+        Bypasses cooldown — refusing demand to honor a timer would be
+        scale-to-zero without the wake half. Returns True when a
+        replica was restored (the caller retries placement once)."""
+        with self._lock:
+            cand = self._pick_spare()
+            if cand is None:
+                return False
+            self.fleet.restore(cand.rid)
+            self._last_action_t = time.monotonic()
+            self.fleet.ops.note_wake()
+            # Flight events are recorded by the tick thread only (the
+            # ring is single-writer); queue the note.
+            self._pending_wakes.append((time.perf_counter(), cand.rid))
+        _LOG.info("autoscale wake: %s restored for demand", cand.rid)
+        return True
+
+    def _drain_wake_notes(self) -> None:
+        while self._pending_wakes:
+            ts, rid = self._pending_wakes.popleft()
+            self.flight.record_event(EV_SCALE_WAKE, ts, aux=rid, a=1.0)
+
+    # -- surfaces ----------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """fleet_health()'s "autoscale" subsection."""
+        states: Dict[str, int] = {}
+        for r in self.fleet.replicas:
+            states[r.state] = states.get(r.state, 0) + 1
+        with self._lock:
+            return {"enabled": True,
+                    "running": (self._thread is not None
+                                and self._thread.is_alive()),
+                    "replica_states": states,
+                    "min_replicas": self.min_replicas,
+                    "max_replicas": self.max_replicas,
+                    "warm_pool": self.warm_pool,
+                    "scale_to_zero": self.scale_to_zero,
+                    "last_decision": self._last_decision,
+                    "spawned": self._spawned}

@@ -319,6 +319,11 @@ class EngineMetrics:
         self.admission_failures = 0
         self.qos_preemptions = 0
         self.qos_queue_depth = {"latency": 0, "standard": 0, "batch": 0}
+        # stop()-path joins that timed out with the thread still alive
+        # (scheduler/reader/pacer wedged on a device op or a lock):
+        # logged once per stop and COUNTED — a silent ignored join is
+        # how zombie threads accumulate unobserved. Always present.
+        self.stuck_thread_joins = 0
         # Session KV pager (serving/kv_pager.py): the pager keeps its
         # own counters behind the tier lock; the engine installs its
         # stats() here so every scrape reads live values. None (pager
@@ -410,6 +415,7 @@ class EngineMetrics:
             "spec_fallback_steps": self.spec_fallback_steps,
             "admission_failures": self.admission_failures,
             "qos_preemptions": self.qos_preemptions,
+            "stuck_thread_joins": self.stuck_thread_joins,
             # Copied so a scrape never observes the scheduler mutating
             # the gauge mid-iteration (dict reads are GIL-atomic, the
             # copy just freezes the snapshot).
@@ -427,6 +433,18 @@ class EngineMetrics:
         out.update(dict.fromkeys(ROUTER_COUNTER_KEYS, 0))
         out["router_queue_depth"] = {}
         out["router_tier_depth"] = {}
+        # Elastic-fleet control-plane counters (serving/fleet.py
+        # FleetOps / serving/chaos.py ChaosStats): a single engine
+        # never autoscales, upgrades or injects faults, but the keys
+        # are always present — 0, never absent — so /metrics keeps one
+        # schema whether an engine or a fleet serves it (the fleet
+        # overrides with real values). Same shared-key-list discipline
+        # as the router block above.
+        from generativeaiexamples_tpu.serving.fleet import (
+            CHAOS_KEYS, FLEET_OPS_KEYS)
+
+        out.update(dict.fromkeys(FLEET_OPS_KEYS, 0))
+        out.update(dict.fromkeys(CHAOS_KEYS, 0))
         # KV-pager counters/gauges (serving/kv_pager.py): one shared
         # key list, zeros when the pager is off — same always-present
         # contract as the router block above.
@@ -622,6 +640,12 @@ class LLMEngine:
         self._wake = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Chaos slow-replica injection (serving/chaos.py): extra sleep
+        # per scheduler iteration. 0.0 (the permanent production value)
+        # costs one float compare per beat; written by the chaos thread
+        # (GIL-atomic float store, the `_running`/`req.cancelled`
+        # cross-thread-flag idiom), read at the loop top.
+        self.chaos_beat_delay_s = 0.0
         # Sampled device memory_stats for span enrichment (see
         # MEMSTATS_SAMPLE_EVERY). Scheduler-thread-only state.
         self._memstats_cache: Optional[dict] = None
@@ -1154,14 +1178,30 @@ class LLMEngine:
         self._running = False
         self._wake.set()
         self._pace_wake.set()
-        if self._thread:
-            self._thread.join(timeout=10)
-        if self._reader:
-            self._reader.join(timeout=10)
-            self._reader = None
-        if self._pace_thread:
-            self._pace_thread.join(timeout=10)
-            self._pace_thread = None
+        # A join that times out with the thread STILL ALIVE (wedged on
+        # a device op / lock) must not pass silently: log once per
+        # stop and count into the always-present stuck_thread_joins
+        # counter so zombie accumulation is observable in /metrics
+        # (and summed fleet-wide).
+        # Snapshot the thread refs ONCE: concurrent stop() callers (a
+        # chaos kill racing the health-probe eviction) otherwise race
+        # each other nulling _reader/_pace_thread mid-check. join() on
+        # an already-joined thread is a no-op, so both callers joining
+        # the same locals is safe.
+        stuck = []
+        threads = [self._thread, self._reader, self._pace_thread]
+        self._reader = None
+        self._pace_thread = None
+        for t in threads:
+            if t is None:
+                continue
+            t.join(timeout=10)
+            if t.is_alive():
+                stuck.append(t.name)
+        if stuck:
+            _LOG.warning("engine stop: %d thread(s) still alive after "
+                         "join timeout: %s", len(stuck), stuck)
+            self.metrics.stuck_thread_joins += len(stuck)
         # Paced tokens still in flight at shutdown must reach their
         # consumers — a blocked stream.get would otherwise hang.
         with self._pace_lock:
@@ -1251,6 +1291,10 @@ class LLMEngine:
         readback latency of the tunnel, this is the difference between
         ~640 and ~1300 tok/s at K=8, B=16."""
         while self._running:
+            if self.chaos_beat_delay_s > 0.0:
+                # Injected slow-replica latency (chaos harness only;
+                # 0.0 in production, one compare per iteration).
+                time.sleep(self.chaos_beat_delay_s)
             did_work = self._admit_waiting()
             # Chunk forwards interleave with decode dispatches (paced
             # by the landed-block beat) instead of monopolizing the

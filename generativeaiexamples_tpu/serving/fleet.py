@@ -38,11 +38,24 @@ Lifecycle:
   router drops its shadow tree (rebalance). restore(rid) re-admits.
 - health: a daemon probe thread checks each replica every
   `fleet.health_interval_s` (engine threads alive for local replicas,
-  GET /health for remote ones); a failed replica is EVICTED — removed
-  from placement, not-yet-started requests requeued onto the
-  survivors, mid-stream requests terminated with an error event
-  (their tokens are on the dead replica; replaying a half-delivered
-  stream would duplicate output).
+  GET /health with a SHORT dedicated timeout for remote ones); a
+  replica is EVICTED only after `fleet.health_fail_threshold`
+  CONSECUTIVE failed probes (one slow poll must not kill a loaded
+  replica) — removed from placement, not-yet-started requests
+  requeued onto the survivors KEEPING their QoS tier/tenant and
+  re-pinning their session affinity, mid-stream requests terminated
+  with an error event (their tokens are on the dead replica;
+  replaying a half-delivered stream would duplicate output).
+- elastic control plane: `add_replica` / `park` / `restore` give the
+  autoscaler (serving/autoscaler.py) runtime topology changes — a
+  "warm" replica is started+warmed but not admitting (instant scale-
+  up), a "parked" one is cold-stopped (scale-to-zero); a submit
+  against a fully parked fleet wakes one replica instead of 503ing.
+  `rolling_upgrade(new_factory)` swaps every local replica's engine
+  one at a time (drain -> steal un-admitted -> swap -> re-warm ->
+  restore) with the invariant of zero failed streams and zero
+  dropped requests; control-plane decisions land in their own
+  flight-recorder lanes (`extra_flight_lanes`) on /debug/timeline.
 """
 
 from __future__ import annotations
@@ -55,6 +68,7 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from generativeaiexamples_tpu.serving.flight import EV_UPGRADE, FlightRecorder
 from generativeaiexamples_tpu.serving.router import PrefixLocalityRouter
 
 _LOG = logging.getLogger(__name__)
@@ -75,7 +89,68 @@ _COUNTER_KEYS = (
     # replicas; the per-lane rings themselves are served by
     # /debug/timeline (one Perfetto lane per local replica).
     "flight_beats", "flight_events",
+    # stop()-path joins that timed out (engine.py stop); the fleet
+    # adds its own control-thread stuck joins on top of this sum.
+    "stuck_thread_joins",
 )
+
+# Fleet control-plane counters (FleetOps below): always present in
+# /metrics — 0, never absent — whether served by a fleet or a single
+# engine (EngineMetrics.snapshot zero-fills the same lists).
+FLEET_OPS_KEYS = (
+    "autoscale_ups", "autoscale_downs", "autoscale_wakes",
+    "upgrade_rolls", "upgrade_replicas_rolled",
+)
+
+# Chaos-injection counters (serving/chaos.py ChaosStats): zeros unless
+# a chaos monkey is attached to the fleet.
+CHAOS_KEYS = (
+    "chaos_injected_kills", "chaos_injected_blackholes",
+    "chaos_injected_slow_beats", "chaos_injected_submit_errors",
+)
+
+
+class FleetOps:
+    """Fleet control-plane counters: autoscaler decisions, rolling
+    upgrades, and the fleet's own stuck thread joins (probe/autoscaler
+    threads — the per-engine stop-path joins live on EngineMetrics and
+    sum separately). Every key is always present in snapshot()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.autoscale_ups = 0
+        self.autoscale_downs = 0
+        self.autoscale_wakes = 0
+        self.upgrade_rolls = 0
+        self.upgrade_replicas_rolled = 0
+        self.stuck_thread_joins = 0
+
+    def note_scale_up(self) -> None:
+        with self._lock:
+            self.autoscale_ups += 1
+
+    def note_scale_down(self) -> None:
+        with self._lock:
+            self.autoscale_downs += 1
+
+    def note_wake(self) -> None:
+        with self._lock:
+            self.autoscale_wakes += 1
+
+    def note_upgrade_roll(self, replicas: int) -> None:
+        with self._lock:
+            self.upgrade_rolls += 1
+            self.upgrade_replicas_rolled += replicas
+
+    def note_stuck_join(self, n: int = 1) -> None:
+        with self._lock:
+            self.stuck_thread_joins += n
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            out = {k: getattr(self, k) for k in FLEET_OPS_KEYS}
+            out["stuck_thread_joins"] = self.stuck_thread_joins
+            return out
 
 
 class FleetUnavailableError(RuntimeError):
@@ -115,7 +190,11 @@ class LocalReplica:
     def __init__(self, rid: str, engine):
         self.rid = rid
         self.engine = engine
-        self.state = "active"  # active | draining | evicted (fleet-owned)
+        # Fleet-owned state machine: active | draining | drained |
+        # evicted | warm (started+warmed, not admitting — the
+        # autoscaler's instant-scale-up pool) | parked (cold-stopped —
+        # scale-to-zero) | upgrading (engine swap in flight).
+        self.state = "active"
 
     @property
     def has_prefix_cache(self) -> bool:
@@ -125,8 +204,27 @@ class LocalReplica:
         if self.has_prefix_cache:
             self.engine.prefix_cache.reporter = fn
 
-    def submit(self, req) -> None:
-        self.engine.submit(req)
+    def submit(self, req):
+        # Returns the engine the request landed on: rolling_upgrade
+        # swaps `self.engine` under live traffic, and the fleet's
+        # submit path compares this against the current engine to
+        # rescue a request that raced onto the discarded one.
+        eng = self.engine
+        eng.submit(req)
+        return eng
+
+    def steal_waiting(self) -> List:
+        """Atomically remove every NOT-YET-ADMITTED request from the
+        engine's waiting deque (the rolling-upgrade drain tail).
+        Admission runs under the same engine lock, so a stolen request
+        can never reach a slot afterwards — its stream stays silent
+        and is safe to re-place on a survivor."""
+        with self.engine._lock:
+            stolen = list(self.engine.waiting)
+            self.engine.waiting.clear()
+            for req in stolen:
+                self.engine._tier_depth(req, -1)
+        return stolen
 
     def healthy(self) -> bool:
         t = getattr(self.engine, "_thread", None)
@@ -180,10 +278,19 @@ class HttpReplica:
     # error event instead (the client retries).
     supports_requeue = False
 
-    def __init__(self, rid: str, base_url: str, timeout_s: float = 300.0):
+    def __init__(self, rid: str, base_url: str, timeout_s: float = 300.0,
+                 probe_timeout_s: float = 2.0):
         self.rid = rid
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        # Health probes get their OWN short connect/read timeout — a
+        # probe riding the 300 s stream timeout would park the probe
+        # loop for 5 minutes per sick replica and starve every other
+        # replica's health check.
+        self.probe_timeout_s = max(0.1, float(probe_timeout_s))
+        # Consecutive failed probes (written by the probe loop only):
+        # backs off the probe deadline below.
+        self._probe_fails = 0
         self.state = "active"
         self.has_prefix_cache = False  # reports can't cross processes
 
@@ -241,12 +348,20 @@ class HttpReplica:
             req.stream.put(_error_event())
 
     def healthy(self) -> bool:
+        # Deadline backoff: each consecutive failure grants the next
+        # probe progressively more time (capped at 3x) — a replica
+        # that is merely LOADED gets leniency on the road to the
+        # fleet's K-consecutive-failure eviction threshold, while a
+        # dead one still fails K short probes quickly.
+        timeout = self.probe_timeout_s * min(self._probe_fails + 1, 3)
         try:
             with urllib.request.urlopen(self.base_url + "/health",
-                                        timeout=5.0) as resp:
-                return json.load(resp).get("status") == "healthy"
+                                        timeout=timeout) as resp:
+                ok = json.load(resp).get("status") == "healthy"
         except Exception:
-            return False
+            ok = False
+        self._probe_fails = 0 if ok else self._probe_fails + 1
+        return ok
 
     def start(self) -> None:
         """Remote process owns its own lifecycle."""
@@ -414,6 +529,18 @@ class FleetMetrics:
              for s in per_replica.values()), default=0)
         out["trace_export_errors"] = trace_export_errors()
         out.update(self._fleet.router.snapshot())
+        # Control-plane counters: the fleet's own ops (autoscaler
+        # decisions, upgrade rolls, fleet-thread stuck joins — added
+        # ON TOP of the per-engine stop-path sum) and chaos stats
+        # when a monkey is attached (zeros otherwise; the keys never
+        # flicker with deployment topology).
+        ops = self._fleet.ops.snapshot()
+        out["stuck_thread_joins"] = ((out.get("stuck_thread_joins") or 0)
+                                     + ops.pop("stuck_thread_joins"))
+        out.update(ops)
+        cs = self._fleet.chaos_stats
+        out.update(cs.snapshot() if cs is not None
+                   else dict.fromkeys(CHAOS_KEYS, 0))
         out["per_replica"] = per_replica
         return out
 
@@ -427,7 +554,8 @@ class EngineFleet:
                  affinity_ttl_s: float = 300.0,
                  load_penalty_tokens: int = 256,
                  shadow_capacity_pages: int = 4096,
-                 health_interval_s: float = 0.0):
+                 health_interval_s: float = 0.0,
+                 health_fail_threshold: int = 3):
         if not replicas:
             raise ValueError("EngineFleet needs at least one replica")
         self.replicas = list(replicas)
@@ -437,15 +565,36 @@ class EngineFleet:
             load_penalty_tokens=load_penalty_tokens,
             shadow_capacity_pages=shadow_capacity_pages)
         self.metrics = FleetMetrics(self)
+        self.ops = FleetOps()
+        # Chaos stats (serving/chaos.py) and autoscaler attach here;
+        # None keeps the /metrics keys zero-filled and the control
+        # paths inert — the static fleet is byte-identical.
+        self.chaos_stats = None
+        self.autoscaler = None
+        # Control-plane flight lanes merged into /debug/timeline next
+        # to the replica lanes: the fleet's own upgrade lane, plus
+        # whatever the autoscaler/chaos controllers register. Each
+        # lane has exactly ONE writer thread (the recorder contract).
+        self.control_flight = FlightRecorder(ring_size=64)
+        self.extra_flight_lanes: Dict[str, FlightRecorder] = {
+            "fleet": self.control_flight}
         self._by_rid = {r.rid: r for r in self.replicas}
         if len(self._by_rid) != len(self.replicas):
             raise ValueError("duplicate replica ids")
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        # Serializes rolling_upgrade callers (and makes the upgrade
+        # lane single-writer).
+        self._upgrade_lock = threading.Lock()
         # rid -> {id(req): _ReqRecord} live requests per replica.
         self._records: Dict[str, Dict[int, _ReqRecord]] = {
             r.rid: {} for r in self.replicas}
         self._health_interval_s = health_interval_s
+        # Consecutive failed probes per rid: eviction fires only at
+        # the threshold (one slow poll must not kill a loaded
+        # replica); any success resets the count.
+        self._health_fail_threshold = max(1, int(health_fail_threshold))
+        self._health_fails: Dict[str, int] = {}
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_stop = threading.Event()
         self._probe_errors = 0
@@ -480,9 +629,25 @@ class EngineFleet:
     def flight_recorders(self) -> Dict[str, Any]:
         """rid -> FlightRecorder for every local replica — the
         /debug/timeline lanes (remote replicas serve their own
-        /debug/timeline; their rings cannot cross processes)."""
-        return {r.rid: r.engine.flight for r in self.local_replicas()
-                if getattr(r.engine, "flight", None) is not None}
+        /debug/timeline; their rings cannot cross processes) — plus
+        the control-plane lanes (fleet upgrades, autoscaler, chaos)
+        so TTFT spikes line up with the scale/kill events that
+        caused them."""
+        out = {r.rid: r.engine.flight for r in self.local_replicas()
+               if getattr(r.engine, "flight", None) is not None}
+        out.update(self.extra_flight_lanes)
+        return out
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Register the elastic controller (serving/autoscaler.py):
+        enables the scale-to-zero wake path in submit() and the
+        autoscaler lifecycle under start()/stop()."""
+        self.autoscaler = autoscaler
+
+    def attach_chaos(self, stats) -> None:
+        """Register a chaos monkey's counters (serving/chaos.py) so
+        /metrics surfaces live chaos_injected_* values."""
+        self.chaos_stats = stats
 
     def submit(self, req):  # graftlint: hot-path
         """Place and dispatch one request. Raises FleetUnavailableError
@@ -492,14 +657,25 @@ class EngineFleet:
             rid = self.router.place(req.prompt_ids,
                                     getattr(req, "session_id", ""))
         except LookupError as e:
-            raise FleetUnavailableError(str(e)) from e
+            # Scale-to-zero wake: with an autoscaler attached, demand
+            # against a fully parked fleet restores one replica and
+            # retries the placement once instead of 503ing.
+            scaler = self.autoscaler
+            if scaler is None or not scaler.wake_for_submit():
+                raise FleetUnavailableError(str(e)) from e
+            try:
+                rid = self.router.place(req.prompt_ids,
+                                        getattr(req, "session_id", ""))
+            except LookupError as e2:
+                raise FleetUnavailableError(str(e2)) from e2
         rec = _ReqRecord(req, rid)
         req.stream = _TrackedStream(self, rec)
         with self._lock:
             self._records[rid][id(req)] = rec
         self.router.note_submitted(rid, rec.est, rec.tier)
+        replica = self._by_rid[rid]
         try:
-            self._by_rid[rid].submit(req)
+            used_engine = replica.submit(req)
         except Exception:
             with self._lock:
                 self._records[rid].pop(id(req), None)
@@ -512,11 +688,23 @@ class EngineFleet:
             # contains submitted records, so exactly one side handles
             # it). The engine we just submitted to is stopped/stopping
             # — move the request to a survivor.
-            raced_evict = (self._by_rid[rid].state == "evicted"
+            raced_evict = (replica.state == "evicted"
                            and self._records[rid].pop(id(req), None)
                            is not None)
+            # A rolling upgrade swapped the replica's engine while
+            # this submit was in flight: the request may sit on the
+            # DISCARDED old engine's queue (frozen — its threads were
+            # joined before the swap), where it would never serve.
+            # The swap sweep only takes records already marked
+            # submitted at sweep time, and we pop under the same
+            # lock, so exactly one side handles each record.
+            raced_swap = (not raced_evict
+                          and used_engine is not None
+                          and used_engine
+                          is not getattr(replica, "engine", None)
+                          and self._records[rid].pop(id(req), None)
+                          is not None)
         if raced_evict and not rec.done:
-            replica = self._by_rid[rid]
             try:
                 # Idempotent: joins the already-stopping engine threads
                 # so it can no longer emit into the stream we re-place.
@@ -536,15 +724,30 @@ class EngineFleet:
                     req.stream.put(_error_event())
             else:
                 self._requeue(rec)
+        elif raced_swap and not rec.done:
+            # The old engine was stopped and joined before the swap:
+            # nothing can emit into this stream, so an untouched
+            # request re-places cleanly; anything already delivered
+            # must terminate, not replay.
+            if rec.started or not getattr(replica, "supports_requeue",
+                                          True):
+                req.cancelled = True
+                req.stream.put(_error_event())
+            else:
+                self._requeue(rec)
         return req
 
     def start(self) -> "EngineFleet":
         for r in self.replicas:
+            if r.state == "parked":
+                continue  # cold-parked by the autoscaler: stays down
             r.start()
         if self._health_interval_s > 0:
             self._probe_thread = threading.Thread(
                 target=self._probe_loop, daemon=True, name="fleet-probe")
             self._probe_thread.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         return self
 
     def warmup(self, **kw) -> "EngineFleet":
@@ -553,9 +756,19 @@ class EngineFleet:
         return self
 
     def stop(self) -> None:
+        # Controller first: a scale decision racing the teardown would
+        # restart replicas the loop below is stopping.
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self._probe_stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10)
+            if self._probe_thread.is_alive():
+                # Same contract as engine.stop(): a timed-out join is
+                # logged and counted, never silently dropped.
+                _LOG.warning("fleet probe thread still alive after "
+                             "join timeout")
+                self.ops.note_stuck_join()
             self._probe_thread = None
         for r in self.replicas:
             r.stop()
@@ -603,13 +816,171 @@ class EngineFleet:
         return emptied
 
     def restore(self, rid: str) -> None:
-        """Re-admit a drained/evicted replica (its cache starts cold —
-        the shadow was dropped at drain/evict time)."""
+        """Re-admit a drained/evicted/parked replica (its cache starts
+        cold — the shadow was dropped at drain/evict/park time)."""
         replica = self._by_rid[rid]
         replica.start()
         with self._lock:
             replica.state = "active"
+            self._health_fails.pop(rid, None)
         self.router.set_admitting(rid, True)
+
+    def add_replica(self, replica, admitting: bool = True) -> None:
+        """Register a replica at RUNTIME (the autoscaler's spawn
+        path): joins the router with a fresh shadow; admitting=False
+        parks it straight into the warm pool."""
+        with self._lock:
+            if replica.rid in self._by_rid:
+                raise ValueError(f"duplicate replica id {replica.rid!r}")
+            self.replicas.append(replica)
+            self._by_rid[replica.rid] = replica
+            self._records[replica.rid] = {}
+            replica.state = "active" if admitting else "warm"
+        self.router.add_replica(
+            replica.rid,
+            self_feed=not getattr(replica, "has_prefix_cache", False))
+        replica.set_reporter(self.router.reporter_for(replica.rid))
+        if not admitting:
+            self.router.set_admitting(replica.rid, False)
+
+    def park(self, rid: str, timeout_s: float = 30.0,
+             cold: bool = False) -> bool:
+        """Scale-down: drain, then hold the replica OUT of placement —
+        "warm" keeps the engine running (pre-warmed pool; restore()
+        re-admits it instantly), cold=True stops it entirely (the
+        scale-to-zero state). Returns False — and re-admits — when
+        the drain did not empty in time: a loaded replica is never
+        parked out from under its streams."""
+        if not self.drain(rid, timeout_s=timeout_s):
+            self.restore(rid)
+            return False
+        replica = self._by_rid[rid]
+        if cold:
+            try:
+                replica.stop()
+            except Exception as e:
+                _LOG.warning("park stop of %s failed: %s", rid, e)
+            self._purge(replica)
+        with self._lock:
+            replica.state = "parked" if cold else "warm"
+        return True
+
+    def rolling_upgrade(self, new_factory, drain_timeout_s: float = 60.0,
+                        warmup: bool = False,
+                        warmup_kw: Optional[Dict] = None) -> Dict[str, Any]:
+        """Zero-loss rolling engine swap: one local replica at a time,
+        drain -> steal un-admitted requests back to survivors (they
+        keep their QoS tier/tenant and re-pin session affinity) ->
+        swap the engine via ``new_factory(old_engine)`` -> re-warm ->
+        restore. The invariant is zero failed streams and zero
+        dropped requests: in-flight streams finish on the old engine
+        before the swap, and a submit racing the swap is rescued by
+        the engine-identity handshake in submit(). Only streams that
+        outlive two drain timeouts are error-terminated (reported in
+        ``failed_streams`` — the bench gates on it staying 0).
+
+        Replicas in the warm/parked pool are swapped without a drain
+        and return to their pool state; evicted replicas are skipped.
+        Returns {replicas_rolled, requeued, failed_streams, wall_s}.
+        """
+        t_start = time.monotonic()
+        rolled = requeued = failed = 0
+        with self._upgrade_lock:
+            for replica in [r for r in self.replicas
+                            if isinstance(r, LocalReplica)]:
+                rid = replica.rid
+                prev = replica.state
+                if prev == "evicted":
+                    continue
+                t0 = time.monotonic()
+                if not self.drain(rid, timeout_s=drain_timeout_s):
+                    # Shorten the tail: whatever never reached a slot
+                    # re-places NOW; admitted streams keep decoding on
+                    # the old engine until they finish.
+                    for req in replica.steal_waiting():
+                        with self._lock:
+                            rec = self._records[rid].pop(id(req), None)
+                        if rec is None or rec.done:
+                            continue
+                        if self._requeue(rec):
+                            requeued += 1
+                        else:
+                            failed += 1
+                    deadline = time.monotonic() + drain_timeout_s
+                    with self._cond:
+                        while self._records[rid]:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            self._cond.wait(left)
+                # Mark the swap BEFORE stopping the old engine: the
+                # probe loop skips "upgrading" replicas, so the
+                # planned stop can never count toward eviction (a
+                # fast prober would otherwise evict mid-swap and
+                # error-terminate the very streams this path
+                # preserves); the autoscaler's wake paths only touch
+                # warm/parked replicas, so nothing restarts the old
+                # engine either.
+                with self._lock:
+                    replica.state = "upgrading"
+                old = replica.engine
+                try:
+                    old.stop()  # joins: the old engine can never emit again
+                except Exception as e:
+                    _LOG.warning("upgrade stop of %s failed: %s", rid, e)
+                new_engine = new_factory(old)
+                with self._lock:
+                    replica.engine = new_engine
+                    # Sweep the stragglers (streams that outlived both
+                    # waits, plus anything evict()-style racing): only
+                    # records marked submitted — an in-flight submit
+                    # that hasn't set the flag detects the swap itself
+                    # (engine-identity check) and handles its own
+                    # record.
+                    recs = self._records[rid]
+                    takeover = [r_ for r_ in recs.values() if r_.submitted]
+                    self._records[rid] = {id(r_.req): r_
+                                          for r_ in recs.values()
+                                          if not r_.submitted}
+                for rec in takeover:
+                    if rec.done:
+                        continue
+                    if rec.started:
+                        # Tokens already delivered: replaying on the
+                        # new engine would duplicate output.
+                        rec.req.cancelled = True
+                        rec.req.stream.put(_error_event())
+                        failed += 1
+                    elif self._requeue(rec):
+                        requeued += 1
+                    else:
+                        failed += 1
+                replica.set_reporter(self.router.reporter_for(rid))
+                if warmup:
+                    try:
+                        replica.warmup(**(warmup_kw or {}))
+                    except Exception as e:
+                        _LOG.warning("upgrade warmup of %s failed: %s",
+                                     rid, e)
+                if prev == "parked":
+                    with self._lock:
+                        replica.state = "parked"
+                else:
+                    replica.start()
+                    if prev == "warm":
+                        with self._lock:
+                            replica.state = "warm"
+                    else:
+                        self.restore(rid)
+                rolled += 1
+                self.control_flight.record_event(
+                    EV_UPGRADE, time.perf_counter(), aux=rid,
+                    a=float(len(self.replicas)),
+                    b=(time.monotonic() - t0) * 1e3)
+            self.ops.note_upgrade_roll(rolled)
+        return {"replicas_rolled": rolled, "requeued": requeued,
+                "failed_streams": failed,
+                "wall_s": round(time.monotonic() - t_start, 3)}
 
     def evict(self, rid: str) -> int:
         """Remove a failed replica from placement: requeue its
@@ -706,19 +1077,47 @@ class EngineFleet:
         return True
 
     def check_health(self) -> Dict[str, bool]:
-        """Probe every non-evicted replica; evict the dead. Returns
-        rid -> healthy."""
+        """Probe every non-evicted replica; evict a replica only after
+        `health_fail_threshold` CONSECUTIVE failed probes (any success
+        resets the count) — one slow poll must not kill a loaded
+        replica. HttpReplica probes additionally use their own short
+        deadline, backed off with consecutive failures. Returns
+        rid -> this round's probe result."""
         out = {}
         for r in self.replicas:
             if r.state == "evicted":
                 out[r.rid] = False
                 continue
-            ok = r.healthy()
+            if r.state in ("parked", "upgrading"):
+                # Intentionally down: cold-parked by the autoscaler
+                # (scale-to-zero) or mid-engine-swap in a rolling
+                # upgrade — probing now would count a planned stop
+                # toward eviction.
+                out[r.rid] = True
+                continue
+            try:
+                ok = bool(r.healthy())
+            except Exception as e:
+                _LOG.warning("health probe of %s raised: %s", r.rid, e)
+                ok = False
             out[r.rid] = ok
-            if not ok:
-                _LOG.warning("fleet replica %s failed health probe; "
-                             "evicting", r.rid)
+            if ok:
+                with self._lock:
+                    self._health_fails.pop(r.rid, None)
+                continue
+            with self._lock:
+                fails = self._health_fails.get(r.rid, 0) + 1
+                self._health_fails[r.rid] = fails
+            if fails >= self._health_fail_threshold:
+                _LOG.warning("fleet replica %s failed %d consecutive "
+                             "health probes; evicting", r.rid, fails)
                 self.evict(r.rid)
+                with self._lock:
+                    self._health_fails.pop(r.rid, None)
+            else:
+                _LOG.warning("fleet replica %s failed health probe "
+                             "(%d/%d)", r.rid, fails,
+                             self._health_fail_threshold)
         return out
 
     def _probe_loop(self) -> None:
@@ -733,7 +1132,10 @@ class EngineFleet:
                     self._probe_errors += 1
 
     def fleet_health(self) -> Dict[str, Any]:
-        """/health "fleet" section: replica states + drain flags."""
+        """/health "fleet" section: replica states + drain flags +
+        consecutive probe failures, plus the elastic control plane
+        (autoscaler/chaos) — always-present subsections, enabled
+        false when nothing is attached."""
         depths = self.router.queue_depths()
         with self._lock:
             replicas = {
@@ -741,19 +1143,29 @@ class EngineFleet:
                     "state": r.state,
                     "draining": r.state == "draining",
                     "queue_depth": depths.get(r.rid, 0),
+                    "probe_fails": self._health_fails.get(r.rid, 0),
                 } for r in self.replicas}
             probe_errors = self._probe_errors
+        scaler = self.autoscaler
         return {"enabled": True, "replicas": replicas,
                 "router_policy": self.router.policy,
-                "probe_errors": probe_errors}
+                "probe_errors": probe_errors,
+                "health_fail_threshold": self._health_fail_threshold,
+                "autoscale": (scaler.health() if scaler is not None
+                              else {"enabled": False}),
+                "chaos": {"enabled": self.chaos_stats is not None}}
 
 
-def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None):
+def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None,
+                engine_factory=None):
     """Wire an EngineFleet from the [fleet] config section.
 
     `engines`: local LLMEngines (emulated/multi-chip fleet). With
     `cfg.fleet.replica_urls` set instead, the fleet fronts remote
-    engine-server processes and `tokenizer` must be provided."""
+    engine-server processes and `tokenizer` must be provided.
+    `engine_factory` (zero-arg -> LLMEngine) enables the autoscaler's
+    spawn path when `fleet.autoscale` is on; without it the
+    autoscaler can still park and wake the existing replicas."""
     fcfg = cfg.fleet
     replicas: List = []
     if engines:
@@ -761,15 +1173,40 @@ def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None):
         replicas += [LocalReplica(f"r{i}", e) for i, e in enumerate(engines)]
     for i, url in enumerate(u for u in
                             (fcfg.replica_urls or "").split(",") if u.strip()):
-        replicas.append(HttpReplica(f"h{i}", url.strip()))
+        replicas.append(HttpReplica(f"h{i}", url.strip(),
+                                    probe_timeout_s=fcfg.probe_timeout_s))
     if tokenizer is None:
         raise ValueError("remote-only fleet needs an explicit tokenizer")
     page_size = engines[0].ecfg.page_size if engines else \
         cfg.engine.page_size
-    return EngineFleet(
+    fleet = EngineFleet(
         replicas, tokenizer, page_size,
         router_policy=fcfg.router_policy,
         affinity_ttl_s=fcfg.affinity_ttl_s,
         load_penalty_tokens=fcfg.load_penalty_tokens,
         shadow_capacity_pages=fcfg.shadow_capacity_pages,
-        health_interval_s=fcfg.health_interval_s)
+        health_interval_s=fcfg.health_interval_s,
+        health_fail_threshold=fcfg.health_fail_threshold)
+    if fcfg.autoscale:
+        from generativeaiexamples_tpu.serving.autoscaler import (
+            FleetAutoscaler)
+
+        FleetAutoscaler(
+            fleet, engine_factory=engine_factory,
+            min_replicas=fcfg.autoscale_min_replicas,
+            max_replicas=fcfg.autoscale_max_replicas,
+            warm_pool=fcfg.autoscale_warm_pool,
+            interval_s=fcfg.autoscale_interval_s,
+            up_depth=fcfg.autoscale_up_depth,
+            down_depth=fcfg.autoscale_down_depth,
+            up_ticks=fcfg.autoscale_up_ticks,
+            down_ticks=fcfg.autoscale_down_ticks,
+            cooldown_s=fcfg.autoscale_cooldown_s,
+            scale_to_zero=fcfg.autoscale_scale_to_zero)
+    if fcfg.chaos:
+        from generativeaiexamples_tpu.serving.chaos import ChaosMonkey
+
+        # Armed but idle: live chaos counters + timeline lane; faults
+        # fire only when an operator/harness runs a schedule.
+        fleet.chaos_monkey = ChaosMonkey(fleet, seed=fcfg.chaos_seed)
+    return fleet

@@ -55,6 +55,17 @@ EV_QOS_PAUSE = 9       # long prefill paused for a latency-tier TTFT phase
 EV_QOS_RESUME = 10     # ... and resumed
 EV_KV_PROMOTE = 11     # pager promote (a = pages, b = ms)
 EV_KV_DEMOTE = 12      # pager/cache reclaim demotion (a = pages)
+# Elastic-fleet control events (serving/autoscaler.py / chaos.py /
+# EngineFleet.rolling_upgrade). These are NOT written into an engine's
+# recorder — each controller owns its own single-writer recorder lane
+# (fleet.extra_flight_lanes), so the scheduler-thread-only invariant
+# above holds per ring. aux carries the replica id; a = active-replica
+# count after the action.
+EV_SCALE_UP = 13       # autoscaler activated/spawned a replica
+EV_SCALE_DOWN = 14     # autoscaler parked a replica (warm/cold)
+EV_SCALE_WAKE = 15     # submit-time wake of a parked fleet (a = 1)
+EV_UPGRADE = 16        # one replica rolled (b = drain+swap ms)
+EV_CHAOS = 17          # chaos injection (aux = "<kind>:<rid>")
 
 EVENT_NAMES = {
     EV_SUBMIT: "submit", EV_QOS_PICK: "qos_pick", EV_ADMIT: "admit",
@@ -63,6 +74,9 @@ EVENT_NAMES = {
     EV_RETIRE: "retire", EV_ADMIT_RETRY: "admission_retry",
     EV_QOS_PAUSE: "qos_pause", EV_QOS_RESUME: "qos_resume",
     EV_KV_PROMOTE: "kv_promote", EV_KV_DEMOTE: "kv_demote",
+    EV_SCALE_UP: "scale_up", EV_SCALE_DOWN: "scale_down",
+    EV_SCALE_WAKE: "scale_wake", EV_UPGRADE: "upgrade",
+    EV_CHAOS: "chaos",
 }
 
 # Retire reason codes (EV_RETIRE.code); anything unknown maps to -1.
@@ -73,6 +87,13 @@ RETIRE_NAMES = {v: k for k, v in RETIRE_CODES.items()}
 # order (a gap containing several causes is charged to the first).
 GAP_CAUSE_KINDS = (EV_QOS_PAUSE, EV_KV_PROMOTE, EV_ADMIT_RETRY,
                    EV_PREFILL_CHUNK, EV_KV_DEMOTE)
+
+# Fleet control-plane instants: rendered on the timeline (cat "fleet",
+# so a TTFT spike can be eyeballed against the scale/upgrade/chaos
+# event that caused it) but deliberately NOT gap causes — a replica's
+# host gap is never *explained* by another replica being scaled.
+FLEET_INSTANT_KINDS = (EV_SCALE_UP, EV_SCALE_DOWN, EV_SCALE_WAKE,
+                       EV_UPGRADE, EV_CHAOS)
 
 BEAT_DTYPE = np.dtype([
     # seq opens the record, seq2 CLOSES it and sits LAST in memory:
@@ -468,13 +489,20 @@ def _request_events(pid: int, events: List[Dict[str, Any]],
     by_rid: Dict[str, Dict[str, Any]] = {}
     for ev in events:
         kind = ev["kind"]
-        if kind in GAP_CAUSE_KINDS or kind == EV_QOS_RESUME:
+        if kind in GAP_CAUSE_KINDS or kind == EV_QOS_RESUME \
+                or kind in FLEET_INSTANT_KINDS:
             out.append({
                 "name": EVENT_NAMES.get(kind, str(kind)),
-                "cat": "gap-cause", "ph": "i", "s": "t",
+                # Fleet control events get their own category: the
+                # analyzer charges host gaps to "gap-cause" instants
+                # only, and a scale decision is context, not a cause.
+                "cat": ("fleet" if kind in FLEET_INSTANT_KINDS
+                        else "gap-cause"),
+                "ph": "i", "s": "t",
                 "pid": pid, "tid": TID_SCHED,
                 "ts": round((ev["ts"] - base) * 1e6, 1),
-                "args": {"rid": ev["rid"], "a": ev["a"], "b": ev["b"]},
+                "args": {"rid": ev["rid"], "a": ev["a"], "b": ev["b"],
+                         "aux": ev["aux"]},
             })
         rid = ev["rid"]
         if not rid:

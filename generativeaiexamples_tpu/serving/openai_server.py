@@ -394,6 +394,18 @@ class OpenAIServer:
                            "type": "invalid_request_error",
                            "code": "unsupported_parameter"}},
                 status=422)
+        except RuntimeError as e:
+            # Replica-side submit fault (a replica dying between
+            # placement and submit, a chaos-injected fault): the
+            # request was fine and the fleet has already unwound its
+            # tracking — a retryable 503, never a raw 500. (Fleet
+            # unavailability is caught above; it subclasses this.)
+            _LOG.warning("submit failed server-side: %s", e)
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "service_unavailable",
+                           "code": "replica_submit_failed"}},
+                status=503)
         created = int(time.time())
         obj = "chat.completion.chunk" if chat else "text_completion"
 

@@ -44,13 +44,19 @@
 #                   count, warm resume from the host tier is
 #                   byte-identical to never-demoted greedy,
 #                   promotions observed).
-#   9. flight smoke — CPU gate for the engine flight recorder
+#   9. chaos smoke — CPU gate for the elastic fleet's crash recovery
+#                   (scripts/smoke_chaos.py: 2 replicas, seeded kill
+#                   mid-burst — zero lost non-mid-stream requests,
+#                   latency goodput >= 0.9x the no-fault baseline,
+#                   kill counted + evicted + on the chaos timeline
+#                   lane, zero zombie threads / stuck joins).
+#  10. flight smoke — CPU gate for the engine flight recorder
 #                   (scripts/smoke_flight.py: recorder on by default,
 #                   beat records >= decode_steps, recorder-on vs -off
 #                   token streams byte-identical, timeline JSON loads
 #                   and spans nest, analyzer attribution sums ~100%,
 #                   overhead <= 1% on paired bursts).
-#  10. tier-1 tests — the ROADMAP.md pytest gate.
+#  11. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -97,6 +103,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "KV-pager smoke (JAX_PLATFORMS=cpu scripts/smoke_kv_pager.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_kv_pager.py || fail=1
+
+    step "chaos smoke (JAX_PLATFORMS=cpu scripts/smoke_chaos.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_chaos.py || fail=1
 
     step "flight smoke (JAX_PLATFORMS=cpu scripts/smoke_flight.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_flight.py || fail=1

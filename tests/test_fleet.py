@@ -295,9 +295,13 @@ class FakeReplica:
 
 
 class TestHealthEvictionAndRequeue:
-    def _fleet(self):
+    def _fleet(self, threshold=1):
+        # threshold=1 evicts on the first failed probe — these tests
+        # exercise eviction mechanics, not the K-consecutive counting
+        # (TestProbeThreshold covers that).
         fakes = [FakeReplica("r0"), FakeReplica("r1")]
-        return EngineFleet(fakes, ByteTokenizer(), PS).start(), fakes
+        return EngineFleet(fakes, ByteTokenizer(), PS,
+                           health_fail_threshold=threshold).start(), fakes
 
     def test_dead_replica_evicted_and_waiting_request_requeued(self):
         fleet, fakes = self._fleet()
@@ -346,6 +350,129 @@ class TestHealthEvictionAndRequeue:
         fleet.check_health()
         with pytest.raises(FleetUnavailableError):
             fleet.submit(GenRequest(prompt_ids=[1] * 8))
+
+
+class TestRequeueFidelity:
+    """A health-evicted replica's requeued request must keep its QoS
+    tier and tenant, and its session must re-pin to the survivor."""
+
+    def test_requeue_keeps_tier_tenant_and_repins_affinity(self):
+        fakes = [FakeReplica("r0"), FakeReplica("r1")]
+        fleet = EngineFleet(fakes, ByteTokenizer(), PS,
+                            health_fail_threshold=1).start()
+        req = GenRequest(prompt_ids=[3] * 24, max_new_tokens=8,
+                         priority="latency", tenant_id="acme",
+                         session_id="sess-1")
+        fleet.submit(req)
+        victim = next(f for f in fakes if f.submitted)
+        other = next(f for f in fakes if not f.submitted)
+        assert fleet.router._affinity["sess-1"][0] == victim.rid
+        victim.alive = False
+        fleet.check_health()
+        # Moved to the survivor with identity intact...
+        assert other.submitted == [req]
+        assert req.priority == "latency" and req.tenant_id == "acme"
+        # ...tier accounting followed it (the survivor's latency-tier
+        # pressure counts the requeued request)...
+        assert fleet.router.tier_queue_depths()[other.rid] == \
+            {"latency": 1}
+        assert fleet.router.tier_queue_depths()[victim.rid] in \
+            ({}, {"latency": 0})
+        # ...and the session re-pinned to the survivor.
+        assert fleet.router._affinity["sess-1"][0] == other.rid
+        # A follow-up turn in the session lands there too.
+        req2 = GenRequest(prompt_ids=[3] * 24, max_new_tokens=8,
+                          priority="latency", tenant_id="acme",
+                          session_id="sess-1")
+        fleet.submit(req2)
+        assert req2 in other.submitted
+
+
+class TestProbeThreshold:
+    """Satellite: K consecutive probe failures before eviction; any
+    success resets the count."""
+
+    def _fleet(self, threshold):
+        fakes = [FakeReplica("r0"), FakeReplica("r1")]
+        fleet = EngineFleet(fakes, ByteTokenizer(), PS,
+                            health_fail_threshold=threshold).start()
+        return fleet, fakes
+
+    def test_eviction_needs_k_consecutive_failures(self):
+        fleet, fakes = self._fleet(threshold=3)
+        fakes[0].alive = False
+        for i in range(2):
+            fleet.check_health()
+            assert fakes[0].state == "active", f"evicted at {i + 1} < K"
+            assert fleet.fleet_health()["replicas"]["r0"]["probe_fails"] \
+                == i + 1
+        fleet.check_health()  # 3rd consecutive: eviction
+        assert fakes[0].state == "evicted"
+        assert fleet.metrics.snapshot()["replica_evictions"] == 1
+
+    def test_one_slow_poll_cannot_kill_a_replica(self):
+        fleet, fakes = self._fleet(threshold=3)
+        fakes[0].alive = False
+        fleet.check_health()
+        fleet.check_health()  # 2/3
+        fakes[0].alive = True  # the replica was merely loaded
+        fleet.check_health()   # success resets the count
+        assert fleet.fleet_health()["replicas"]["r0"]["probe_fails"] == 0
+        fakes[0].alive = False
+        fleet.check_health()
+        fleet.check_health()  # 2/3 again — still not evicted
+        assert fakes[0].state == "active"
+
+    def test_http_probe_uses_short_dedicated_timeout(self):
+        """HttpReplica probes ride probe_timeout_s, not the 300 s
+        stream timeout — and back the deadline off with consecutive
+        failures."""
+        from generativeaiexamples_tpu.serving.fleet import HttpReplica
+
+        rep = HttpReplica("h0", "http://127.0.0.1:9", timeout_s=300.0,
+                          probe_timeout_s=0.2)
+        t0 = time.monotonic()
+        assert rep.healthy() is False
+        assert time.monotonic() - t0 < 5.0  # not the stream timeout
+        assert rep._probe_fails == 1
+        assert rep.healthy() is False
+        assert rep._probe_fails == 2
+
+
+class TestStuckThreadJoins:
+    def test_stop_counts_threads_alive_after_join_timeout(self, params):
+        """A stop()-path join that times out must be counted, not
+        silently ignored."""
+
+        class Immortal:
+            name = "llm-engine-immortal"
+
+            def join(self, timeout=None):
+                pass
+
+            def is_alive(self):
+                return True
+
+        eng = make_engine(params)
+        eng.start()
+        eng.stop()
+        assert eng.metrics.stuck_thread_joins == 0
+        eng._reader = Immortal()
+        eng.stop()
+        assert eng.metrics.stuck_thread_joins == 1
+        assert eng.metrics.snapshot()["stuck_thread_joins"] == 1
+
+    def test_fleet_sums_engine_stuck_joins(self):
+        class StuckFake(FakeReplica):
+            def metrics_snapshot(self):
+                return {"stuck_thread_joins": 2}
+
+        fleet = EngineFleet([StuckFake("r0"), FakeReplica("r1")],
+                            ByteTokenizer(), PS)
+        assert fleet.metrics.snapshot()["stuck_thread_joins"] == 2
+        # The fleet's own control-thread stuck joins add on top.
+        fleet.ops.note_stuck_join()
+        assert fleet.metrics.snapshot()["stuck_thread_joins"] == 3
 
 
 # ---------------------------------------------------------------------------
@@ -411,7 +538,7 @@ class TestFleetE2E:
         must actually RESTART the scheduler (the stop leaves the joined
         thread object behind), or re-admitted traffic would queue on a
         parked engine forever."""
-        fleet, engines = make_fleet(params)
+        fleet, engines = make_fleet(params, health_fail_threshold=1)
         try:
             engines[0].stop()  # dies out from under the fleet
             assert fleet.check_health()["r0"] is False
@@ -429,7 +556,8 @@ class TestFleetE2E:
         """A request parked in a dead replica's waiting deque is
         requeued to a survivor AND purged from the dead engine, so a
         later restore() cannot replay it into the survivor's stream."""
-        fleet, engines = make_fleet(params, router_policy="round_robin")
+        fleet, engines = make_fleet(params, router_policy="round_robin",
+                                    health_fail_threshold=1)
         try:
             engines[0].stop()  # r0's scheduler parks; deque accumulates
             reqs = [GenRequest(prompt_ids=[i + 3] * 16, max_new_tokens=6)
@@ -535,11 +663,13 @@ class TestCounterSurfaces:
             # Process-global monotonic counter (other tests exercise
             # tracing failure paths in-process): present, not zero.
             assert snap["trace_export_errors"] >= 0
-            # The fleet's /debug/timeline lanes: one per local replica.
+            # The fleet's /debug/timeline lanes: one per local replica
+            # plus the control-plane lane (fleet upgrades; autoscaler/
+            # chaos lanes join it when attached).
             recs = fleet.flight_recorders()
-            assert set(recs) == {"r0", "r1"}
+            assert set(recs) == {"r0", "r1", "fleet"}
             trace = flight_mod.chrome_trace(recs)
-            assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+            assert {e["pid"] for e in trace["traceEvents"]} == {0, 1, 2}
         finally:
             fleet.stop()
 

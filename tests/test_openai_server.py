@@ -450,3 +450,27 @@ def test_speculative_engine_serving_surface():
         assert "spec_tokens_per_step" in m
     finally:
         llm.stop()
+
+
+def test_replica_submit_fault_maps_to_503(server):
+    """A replica-side submit fault (a chaos-injected fault, a replica
+    dying between placement and submit) is a retryable 503, never a
+    raw 500 — the request was fine and the fleet unwound its
+    tracking."""
+    llm, emb, rr = server
+
+    class FaultyFleet:
+        tokenizer = llm.tokenizer
+        metrics = llm.metrics
+
+        def submit(self, req):
+            raise RuntimeError("injected submit fault on r0")
+
+    async def body(c):
+        resp = await c.post("/v1/completions", json={
+            "prompt": [5] * 4, "max_tokens": 4})
+        return resp.status, await resp.json()
+
+    status, data = _client_call((FaultyFleet(), emb, rr), body)
+    assert status == 503
+    assert data["error"]["code"] == "replica_submit_failed"
