@@ -38,7 +38,11 @@ see scripts/bench_qos.py),
 BENCH_CHAOS (0 skips; BENCH_CHAOS_SEED / _HORIZON_S /
 _BATCH_REQUESTS / _LATENCY_RPS / _SLO_TTFT_MS / _KILL_T tune the
 replayed trace, the SLO, and when the replica kill fires — a
-CPU-backend child process, see scripts/bench_chaos.py).
+CPU-backend child process, see scripts/bench_chaos.py),
+BENCH_DISAGG (0 skips; BENCH_DISAGG_PROMPT / _XFERS / _STORM /
+_STORM_PROMPT / _SHORTS / _SHORT_PROMPT / _SHORT_GAP_S / _SLO_S tune
+the transfer microbench and the prefill-storm workload — a
+CPU-backend child process, see scripts/bench_disagg.py).
 
 Flags: --repeat N runs the headline decode burst N times and reports
 the MEDIAN as the headline value, with per-run values and spread under
@@ -175,6 +179,16 @@ Scenario output keys (under "extras"):
                  sustained burst, scale events visible on the
                  /debug/timeline control lanes. CPU-backend child
                  (scripts/bench_chaos.py). BENCH_CHAOS=0 skips)
+  BENCH_DISAGG   disagg_transfer_ms_per_page / _bytes_per_page /
+                 disagg_ttft_storm_p95_ms vs
+                 colocated_ttft_storm_p95_ms /
+                 disagg_vs_colocated_goodput (a prefill-role ->
+                 decode-role KV page transfer microbench, then short
+                 latency-tier requests timed while long chunked
+                 prefills storm a 2-replica fleet — two-stage
+                 disaggregated plans vs the colocated baseline,
+                 serving/disagg.py. CPU-backend child
+                 (scripts/bench_disagg.py). BENCH_DISAGG=0 skips)
 
 `python bench.py --help` prints this header and exits.
 
@@ -182,7 +196,7 @@ Sibling tooling (same checkout):
   scripts/smoke_prefix_cache.py / smoke_ann.py / smoke_tiered_ann.py /
   smoke_microbatch.py / smoke_fused_step.py / smoke_plan_step.py /
   smoke_router.py / smoke_kv_pager.py / smoke_flight.py /
-  smoke_chaos.py
+  smoke_chaos.py / smoke_disagg.py
       targeted CPU smoke gates for the serving subsystems
   scripts/analyze_timeline.py build/timeline_fused.json
       stall attribution over a /debug/timeline (or bench) artifact:
@@ -683,6 +697,18 @@ def main() -> None:
         except Exception as e:
             chaos_stats = {"chaos_error": f"{type(e).__name__}: {e}"}
 
+    # -- disaggregated prefill/decode (ISSUE 14 tentpole — the
+    # serving-topology gate): page-transfer ms/page + bytes/page
+    # across a prefill-role -> decode-role replica pair, and short-
+    # request TTFT p95 + goodput while long prefills storm the fleet,
+    # disaggregated vs colocated. CPU-backend child like fleet/QoS.
+    disagg_stats = {}
+    if os.environ.get("BENCH_DISAGG", "1") != "0":
+        try:
+            disagg_stats = _bench_disagg()
+        except Exception as e:
+            disagg_stats = {"disagg_error": f"{type(e).__name__}: {e}"}
+
     tps = statistics.median(tps_runs)
     out = {
         "metric": f"decode_tokens_per_sec_per_chip_llama3_{model}"
@@ -729,6 +755,7 @@ def main() -> None:
             **fleet_stats,
             **qos_stats,
             **chaos_stats,
+            **disagg_stats,
         },
     }
     # Provenance is pinned: the scenario refuses to emit an artifact
@@ -753,6 +780,12 @@ def _bench_qos():
     """Spawn scripts/bench_qos.py on the CPU backend and merge its
     one-line JSON result (BENCH_QOS_* env knobs pass through)."""
     return _cpu_child_scenario("bench_qos.py", "qos_error")
+
+
+def _bench_disagg():
+    """Spawn scripts/bench_disagg.py on the CPU backend and merge its
+    one-line JSON result (BENCH_DISAGG_* env knobs pass through)."""
+    return _cpu_child_scenario("bench_disagg.py", "disagg_error")
 
 
 def _bench_chaos():

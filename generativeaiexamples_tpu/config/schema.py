@@ -461,6 +461,36 @@ class FleetConfig:
     # deadline (NOT the 300 s stream timeout), backed off up to 3x
     # with consecutive failures.
     probe_timeout_s: float = 2.0
+    # -- disaggregated prefill/decode (serving/disagg.py, the
+    # DistServe/Mooncake shape). Off by default: the static colocated
+    # fleet is byte-identical with disagg=False.
+    # Comma-separated roles assigned positionally to replicas (locals
+    # r0..rN first, then remote h0..hM): prefill | decode | mixed.
+    # "prefill" replicas run prefill stages only and NEVER receive
+    # decode placements; unlisted replicas stay "mixed". E.g.
+    # "prefill,decode" splits a 2-replica fleet.
+    replica_roles: str = ""
+    # Two-stage serving: the router plans prefill on a prefill-role
+    # replica, the finished prefill's KV pages transfer to the chosen
+    # decode replica (one batched gather + one scatter, int8 codes +
+    # scales verbatim — bit-identical), and decode resumes from the
+    # transferred prefix through the normal prefix-cache hit path
+    # with zero re-prefill. Requires engine.prefix_cache on the
+    # replicas and at least one prefill-role replica; any stage
+    # failure falls back to colocated serving on the same stream.
+    disagg: bool = False
+    # Prompts shorter than this many tokens skip the two-stage plan
+    # and serve directly on a decode-pool replica (still never on a
+    # prefill-role one): a short prompt's prefill is cheaper than a
+    # page transfer, and keeping it off the prefill pool is what
+    # shields latency-tier TTFT while long prefills storm that pool.
+    # 0 = every full-page prompt goes two-stage.
+    disagg_min_prompt_tokens: int = 0
+    # How long the fleet waits for the prefill stage to finish before
+    # falling back to colocated serving.
+    disagg_prefill_timeout_s: float = 120.0
+    # Deadline for the export -> import page transfer itself.
+    disagg_transfer_timeout_s: float = 60.0
     # -- elastic autoscaler (serving/autoscaler.py). Off by default:
     # the static fleet is byte-identical with autoscale=False.
     autoscale: bool = False
@@ -487,6 +517,16 @@ class FleetConfig:
     # scale-to-zero); arriving demand wakes one replica instead of
     # getting a 503.
     autoscale_scale_to_zero: bool = False
+    # Latency-histogram scale-up signals (ROADMAP item-5 remainder):
+    # scale up when the fleet's latency-tier queue-wait p95 — or TTFT
+    # p95 — over the LAST POLL WINDOW (bucket-wise histogram delta,
+    # not the cumulative view) exceeds these, even while raw queue
+    # depth looks healthy. 0 disables each signal (depth-only, the
+    # PR-13 behavior). Role-aware under disagg: the signal is
+    # attributed to the role pool whose replicas produced it, so
+    # prefill and decode pools scale independently.
+    autoscale_up_queue_wait_p95_ms: float = 0.0
+    autoscale_up_ttft_p95_ms: float = 0.0
     # -- chaos harness (serving/chaos.py). Off by default; on, the
     # fleet carries an armed ChaosMonkey (live chaos_injected_*
     # counters, a "chaos" /debug/timeline lane) for fault drills —

@@ -81,7 +81,10 @@ class FleetAutoscaler:
                  up_ticks: int = 2, down_ticks: int = 5,
                  cooldown_s: float = 20.0, scale_to_zero: bool = False,
                  drain_timeout_s: float = 30.0,
-                 signal_fn: Optional[Callable] = None):
+                 signal_fn: Optional[Callable] = None,
+                 up_queue_wait_p95_ms: float = 0.0,
+                 up_ttft_p95_ms: float = 0.0,
+                 hist_fn: Optional[Callable] = None):
         self.fleet = fleet
         self.engine_factory = engine_factory
         self.min_replicas = max(0, int(min_replicas))
@@ -96,6 +99,24 @@ class FleetAutoscaler:
         self.scale_to_zero = bool(scale_to_zero)
         self.drain_timeout_s = float(drain_timeout_s)
         self._signal_fn = signal_fn
+        # Latency-histogram scale-up signals (ROADMAP item-5
+        # remainder): per-poll DELTA p95 of latency-tier queue wait /
+        # TTFT across active local replicas, role-attributed so the
+        # prefill and decode pools scale independently under disagg.
+        # 0 disables each (depth-only — byte-identical to PR 13).
+        self.up_queue_wait_p95_ms = float(up_queue_wait_p95_ms)
+        self.up_ttft_p95_ms = float(up_ttft_p95_ms)
+        # hist_fn (tests): -> [(rid, role, {"queue_wait": snap,
+        # "ttft": snap})] replacing the live engine-histogram reads.
+        self._hist_fn = hist_fn
+        # (rid, key) -> last cumulative snapshot (tick thread only).
+        self._prev_hists: Dict = {}
+        # Role pool behind the latest up-pressure ("" = none/any) and
+        # the last observed delta p95s, for health() and the hot-role
+        # spare/spawn preference. Written under _lock.
+        self._hot_role = ""
+        self._last_delta_p95: Dict[str, Optional[float]] = {
+            "queue_wait": None, "ttft": None}
         # Decision state (all under _lock; wake_for_submit races tick).
         self._lock = threading.Lock()
         self._above = 0
@@ -163,6 +184,103 @@ class FleetAutoscaler:
                 total += n * TIER_LOAD_WEIGHT.get(tier, 1)
         return total, len(active)
 
+    def _role_pressures(self) -> Dict[str, float]:
+        """Tier-weighted depth PER ACTIVE REPLICA for each role pool —
+        the role-aware view of _signal (disagg: a drowning prefill
+        pool must not be masked by idle decode replicas averaging the
+        fleet-wide pressure down)."""
+        depths = self.fleet.router.tier_queue_depths()
+        totals: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        for r in self.fleet.replicas:
+            if r.state != "active":
+                continue
+            role = getattr(r, "role", "mixed")
+            counts[role] = counts.get(role, 0) + 1
+            for tier, n in depths.get(r.rid, {}).items():
+                totals[role] = totals.get(role, 0.0) \
+                    + n * TIER_LOAD_WEIGHT.get(tier, 1)
+        return {role: totals.get(role, 0.0) / max(1, counts[role])
+                for role in counts}
+
+    # -- latency-histogram signal (ROADMAP item-5 remainder) ---------------
+
+    def _hist_snapshots(self):
+        """[(rid, role, {"queue_wait": snap, "ttft": snap})] for every
+        active LOCAL replica (remote replicas' histograms ride their
+        own autoscalers). Cheap: single-writer histogram copies, no
+        HTTP."""
+        if self._hist_fn is not None:
+            return self._hist_fn()
+        out = []
+        for r in self.fleet.replicas:
+            if r.state != "active" or not isinstance(r, LocalReplica):
+                continue
+            hists = r.engine.metrics.hists
+            out.append((r.rid, getattr(r, "role", "mixed"), {
+                "queue_wait": hists["queue_wait_ms_latency"].snapshot(),
+                "ttft": hists["ttft_ms"].snapshot()}))
+        return out
+
+    @staticmethod
+    def _hist_delta(cur: Dict, prev: Optional[Dict]) -> Optional[Dict]:
+        """Bucket-wise difference of two cumulative histogram
+        snapshots — the per-poll window view. None on the first
+        sighting (recording the baseline; old history must not fire
+        the signal at attach time)."""
+        if prev is None:
+            return None
+        pb = prev.get("buckets") or {}
+        buckets = {}
+        for k, v in (cur.get("buckets") or {}).items():
+            d = int(v) - int(pb.get(k, 0))
+            if d > 0:
+                buckets[k] = d
+        return {"count": max(0, int(cur.get("count") or 0)
+                             - int(prev.get("count") or 0)),
+                "sum": max(0.0, float(cur.get("sum") or 0.0)
+                           - float(prev.get("sum") or 0.0)),
+                "overflow": max(0, int(cur.get("overflow") or 0)
+                                - int(prev.get("overflow") or 0)),
+                "buckets": buckets}
+
+    def _latency_pressure(self):
+        """-> (hot, role): True when the last poll window's latency-
+        tier queue-wait p95 (or TTFT p95) exceeds its threshold; role
+        is the pool whose merged delta was worst (role-aware scale-up
+        under disagg). Tick thread only (owns _prev_hists)."""
+        from generativeaiexamples_tpu.serving.flight import (
+            merge_hist_snapshots)
+
+        if self.up_queue_wait_p95_ms <= 0 and self.up_ttft_p95_ms <= 0:
+            return False, ""
+        per_role: Dict[str, Dict[str, list]] = {}
+        for rid, role, snaps in self._hist_snapshots():
+            for key, cur in snaps.items():
+                delta = self._hist_delta(cur,
+                                         self._prev_hists.get((rid, key)))
+                self._prev_hists[(rid, key)] = cur
+                if delta is not None and delta["count"] > 0:
+                    per_role.setdefault(role, {}).setdefault(
+                        key, []).append(delta)
+        hot, hot_role, worst = False, "", 0.0
+        last: Dict[str, Optional[float]] = {"queue_wait": None,
+                                            "ttft": None}
+        for key, thresh in (("queue_wait", self.up_queue_wait_p95_ms),
+                            ("ttft", self.up_ttft_p95_ms)):
+            for role, by_key in per_role.items():
+                if key not in by_key:
+                    continue
+                p95 = merge_hist_snapshots(by_key[key])["p95"]
+                if p95 is None:
+                    continue
+                last[key] = max(last[key] or 0.0, p95)
+                if thresh > 0 and p95 >= thresh and p95 > worst:
+                    hot, hot_role, worst = True, role, p95
+        with self._lock:
+            self._last_delta_p95 = last
+        return hot, hot_role
+
     # -- the decision step (unit-testable: injected clock + signal) --------
 
     def tick(self, now: Optional[float] = None) -> str:
@@ -178,8 +296,25 @@ class FleetAutoscaler:
         self._drain_wake_notes()
         total, active = self._signal()
         pressure = total / max(1, active)
+        # Second scale-up signal: latency-histogram drift over the
+        # last poll window (0-thresholds keep it inert). Role-aware:
+        # the hot role steers which spare wakes / what role a spawn
+        # gets, so prefill and decode pools scale independently.
+        lat_hot, lat_role = self._latency_pressure()
+        hot_role = lat_role
+        if not hot_role and active > 0:
+            roles = self._role_pressures()
+            if len(roles) > 1:
+                worst = max(roles, key=lambda k: roles[k])
+                if roles[worst] >= self.up_depth:
+                    hot_role = worst
         with self._lock:
-            if active > 0 and pressure >= self.up_depth:
+            self._hot_role = hot_role
+            # A single drowning role pool (hot_role from depth) counts
+            # as up-pressure even when idle pools average the fleet-
+            # wide signal below the threshold.
+            if active > 0 and (pressure >= self.up_depth or lat_hot
+                               or bool(hot_role)):
                 self._above += 1
                 self._below = 0
             elif total == 0 or pressure <= self.down_depth:
@@ -221,11 +356,22 @@ class FleetAutoscaler:
 
     def _pick_spare(self):
         """Best wakeable spare: warm (instant) before cold-parked
-        (engine restart). Caller holds the lock."""
+        (engine restart), preferring a spare whose role matches the
+        hot pool (mixed spares serve any pool). Caller holds the
+        lock."""
         cands = [r for r in self.fleet.replicas if r.state in _WAKEABLE]
         if not cands:
             return None
-        return min(cands, key=lambda r: (_WAKEABLE.index(r.state), r.rid))
+        hot = self._hot_role
+
+        def role_rank(r) -> int:
+            role = getattr(r, "role", "mixed")
+            if not hot or role == hot:
+                return 0
+            return 1 if role == "mixed" else 2
+
+        return min(cands, key=lambda r: (role_rank(r),
+                                         _WAKEABLE.index(r.state), r.rid))
 
     def _scale_up(self, now: float, active: int) -> bool:
         """Wake a warm spare (fast — pick + restore under the lock,
@@ -270,7 +416,18 @@ class FleetAutoscaler:
                        if r.state == "active"]
             if not actives:
                 return False
-            victim = min(actives,
+            # Role-aware: never drain the LAST active replica of a
+            # role pool while another pool keeps multiple (disagg must
+            # not lose its only prefill — or only decode — replica to
+            # a fleet-wide idle signal).
+            by_role: Dict[str, int] = {}
+            for r in actives:
+                role = getattr(r, "role", "mixed")
+                by_role[role] = by_role.get(role, 0) + 1
+            cands = [r for r in actives
+                     if len(by_role) <= 1
+                     or by_role[getattr(r, "role", "mixed")] > 1]
+            victim = min(cands or actives,
                          key=lambda r: (depths.get(r.rid, 0), r.rid))
             cold = sum(1 for r in self.fleet.replicas
                        if r.state == "warm") >= self.warm_pool
@@ -303,7 +460,9 @@ class FleetAutoscaler:
         with self._lock:
             self._spawned += 1
             rid = f"as{self._spawned}"
+            role = self._hot_role or "mixed"
         replica = LocalReplica(rid, engine)
+        replica.role = role  # joins the hot pool (disagg roles)
         replica.start()
         self.fleet.add_replica(replica, admitting=admitting)
         return rid
@@ -367,4 +526,12 @@ class FleetAutoscaler:
                     "warm_pool": self.warm_pool,
                     "scale_to_zero": self.scale_to_zero,
                     "last_decision": self._last_decision,
-                    "spawned": self._spawned}
+                    "spawned": self._spawned,
+                    # Latency-histogram signal (0-thresholds = off)
+                    # and the role pool behind the latest pressure.
+                    "latency_signal": {
+                        "up_queue_wait_p95_ms": self.up_queue_wait_p95_ms,
+                        "up_ttft_p95_ms": self.up_ttft_p95_ms,
+                        "last_delta_p95": dict(self._last_delta_p95),
+                    },
+                    "hot_role": self._hot_role}

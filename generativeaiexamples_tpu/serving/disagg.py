@@ -1,0 +1,182 @@
+"""Disaggregated prefill/decode: KV page transfer between replicas.
+
+The DistServe/Mooncake serving shape (OSDI'24): prefill-heavy work and
+decode beats have opposite resource profiles — a long prefill is one
+huge compute burst that serializes ahead of every decode block on the
+same device queue, while decode wants steady short beats. The fleet
+therefore specializes replicas by ROLE (`fleet.replica_roles`):
+"prefill" replicas run chunked/fused prefill stages only and never
+receive decode placements, "decode"/"mixed" replicas serve normal
+traffic. `PrefixLocalityRouter.place_disagg` emits the two-stage plan
+(prefill replica -> decode replica), and this module moves the
+finished prefill's KV pages between them.
+
+Transfer path (host bounce — the portable baseline; an ICI/DCN
+collective fast path can slot in behind the same `KVPageTransfer`
+surface later):
+
+  1. the prefill stage runs on the prefill-role replica; its completed
+     prefill inserts the prompt's full pages into that replica's radix
+     prefix cache (the existing admission path — nothing new runs on
+     the prefill side);
+  2. `export`: ONE batched `engine_model.pool_to_pages` gather on the
+     source moves the whole prefix device->host (a pager-demoted tail
+     is read straight from its cold tier — serving/kv_pager.py
+     `read_pages`); int8 codes + narrow scales travel VERBATIM, so
+     the transfer is bit-identical to never having left the pool;
+  3. the bytes cross the replica boundary: in-process as numpy arrays
+     (LocalReplica), or serialized through `serialize_kv_transfer`
+     over the replica's `/v1/kv/import` endpoint (HttpReplica);
+  4. `import`: ONE `engine_model.pages_to_pool` scatter seats the
+     pages on the target and the prefix enters the target's radix
+     tree, so the decode submit that follows takes the NORMAL
+     prefix-cache hit path — zero re-prefill of the transferred
+     prefix, and later turns of the same session hit the same cache.
+
+Both engine halves run as scheduler-thread control ops
+(`LLMEngine.run_control_op`), so the tree/allocator/pool single-owner
+discipline holds across the transfer. Failures at any stage fall back
+to colocated serving on the same stream (`EngineFleet._submit_disagg`)
+— disagg is an optimization, never a correctness dependency, and
+`fleet.disagg=false` (the default) is byte-identical to the static
+fleet.
+
+Wire format (`serialize_kv_transfer`): a fixed magic + JSON header
+(shapes/dtypes/token count) followed by raw little-endian array bytes
+— self-describing, picklable, and streamable through a socket without
+a deserialization framework on either side.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LOG = logging.getLogger(__name__)
+
+_MAGIC = b"GKVT1"
+
+
+def page_geometry(pool) -> Tuple[tuple, np.dtype, Optional[tuple]]:
+    """(codes_shape, codes_dtype, scales_shape|None) of ONE page of
+    `pool` in pool_to_pages' page-major layout — the shared contract
+    between export, import, the KV pager and the wire format."""
+    if pool.quantized:
+        _, L, KH, _, ps, Hd = pool.kv.shape
+        return (2, L, KH, ps, Hd), np.dtype(np.int8), (2, L, KH, ps)
+    L, KH, _, ps, Hd = pool.k.shape
+    return (2, L, KH, ps, Hd), np.dtype(pool.k.dtype), None
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype NAME -> np.dtype, resolving the ml_dtypes extension types
+    (bfloat16 & friends) that plain np.dtype(...) may not know — the
+    default engine KV dtype is bfloat16, and its legacy ``.str`` form
+    is an unreconstructible void ("|V2")."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_kv_transfer(ids: Sequence[int], codes: np.ndarray,
+                          scales: Optional[np.ndarray]) -> bytes:
+    """Pack one transfer (prompt ids + page-major KV bytes) into a
+    self-describing buffer: magic | u32 header len | JSON header |
+    int32 ids | codes bytes | scales bytes. Codes/scales are exactly
+    pool_to_pages' layout, moved verbatim (never re-quantized).
+    Dtypes travel by NAME ("bfloat16", "float32", "int8") so the
+    ml_dtypes extension types reconstruct; multi-byte types are
+    little-endian on the wire (every supported platform is)."""
+    codes = np.ascontiguousarray(codes)
+    header = {
+        "n_ids": len(ids),
+        "codes_dtype": codes.dtype.name,
+        "codes_shape": list(codes.shape),
+        "scales_shape": (list(scales.shape) if scales is not None
+                         else None),
+    }
+    hb = json.dumps(header).encode()
+    parts = [_MAGIC, struct.pack("<I", len(hb)), hb,
+             np.asarray(list(ids), np.int32).tobytes(), codes.tobytes()]
+    if scales is not None:
+        parts.append(np.ascontiguousarray(scales, np.float32).tobytes())
+    return b"".join(parts)
+
+
+def deserialize_kv_transfer(buf: bytes) -> Tuple[List[int], np.ndarray,
+                                                 Optional[np.ndarray]]:
+    """Inverse of serialize_kv_transfer -> (ids, codes, scales). The
+    arrays are reconstructed bit-identical (the round-trip test pins
+    this for f32 and int8+scales through a socket boundary)."""
+    if buf[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("not a KV transfer payload (bad magic)")
+    try:
+        off = len(_MAGIC)
+        (hlen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        header = json.loads(buf[off: off + hlen].decode())
+        off += hlen
+        n_ids = int(header["n_ids"])
+        ids = np.frombuffer(buf, np.int32, count=n_ids,
+                            offset=off).tolist()
+        off += n_ids * 4
+        codes_dtype = _resolve_dtype(header["codes_dtype"])
+        codes_shape = tuple(header["codes_shape"])
+        n_codes = int(np.prod(codes_shape))
+        codes = np.frombuffer(buf, codes_dtype, count=n_codes,
+                              offset=off).reshape(codes_shape).copy()
+        off += n_codes * codes_dtype.itemsize
+        scales = None
+        if header["scales_shape"] is not None:
+            ss = tuple(header["scales_shape"])
+            scales = np.frombuffer(buf, np.float32,
+                                   count=int(np.prod(ss)),
+                                   offset=off).reshape(ss).copy()
+    except ValueError:
+        raise
+    except Exception as e:
+        # Truncated/garbled payloads surface as struct.error /
+        # KeyError / JSONDecodeError / AttributeError depending on
+        # where the bytes run out — normalize to ValueError so the
+        # import endpoint answers 422 bad_kv_payload, not a 503 that
+        # pollutes the availability signal.
+        raise ValueError(f"malformed KV transfer payload: "
+                         f"{type(e).__name__}: {e}") from e
+    return ids, codes, scales
+
+
+class KVPageTransfer:
+    """Host-bounce page mover between two fleet replicas. Stateless
+    beyond its timeout; the fleet owns counters and fallback policy.
+    `transfer` returns (pages_imported, wall_ms) — 0 pages with no
+    exception means the source had nothing cached (the caller falls
+    back) or the target already held the prefix (success: the decode
+    submit hits the cache either way)."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        self.timeout_s = float(timeout_s)
+
+    # graftlint: hot-path
+    def transfer(self, src, dst, ids: Sequence[int]
+                 ) -> Tuple[int, float]:
+        """Export `ids`' cached prefix from `src` and import it into
+        `dst` (replica objects with export_kv_pages/import_kv_pages).
+        Raises on stage failure — the fleet maps that to the
+        colocated fallback."""
+        t0 = time.perf_counter()
+        exported = src.export_kv_pages(ids, timeout_s=self.timeout_s)
+        if exported is None:
+            return 0, (time.perf_counter() - t0) * 1e3
+        codes, scales, n_tokens = exported
+        pages = dst.import_kv_pages(list(ids)[:n_tokens] if n_tokens
+                                    else list(ids), codes, scales,
+                                    timeout_s=self.timeout_s)
+        return pages, (time.perf_counter() - t0) * 1e3

@@ -69,9 +69,9 @@ from generativeaiexamples_tpu.serving.kv_cache import (
 from generativeaiexamples_tpu.serving import flight as flight_mod
 from generativeaiexamples_tpu.serving.flight import (
     EV_ADMIT, EV_ADMIT_RETRY, EV_FIRST_TOKEN, EV_KV_DEMOTE, EV_KV_PROMOTE,
-    EV_PREFILL_CHUNK, EV_PREFILL_DISPATCH, EV_QOS_PAUSE, EV_QOS_PICK,
-    EV_QOS_RESUME, EV_RETIRE, EV_SUBMIT, RETIRE_CODES, ExpHistogram,
-    FlightRecorder)
+    EV_KV_TRANSFER, EV_PREFILL_CHUNK, EV_PREFILL_DISPATCH, EV_QOS_PAUSE,
+    EV_QOS_PICK, EV_QOS_RESUME, EV_RETIRE, EV_SUBMIT, RETIRE_CODES,
+    ExpHistogram, FlightRecorder)
 from generativeaiexamples_tpu.serving.qos import request_tier, tier_id
 from generativeaiexamples_tpu.utils.tokenizer import StreamDetokenizer
 
@@ -310,6 +310,13 @@ class EngineMetrics:
         self.prefix_miss = 0
         self.prefix_evictions = 0
         self.prefix_hit_tokens = 0
+        # Disaggregated prefill/decode (serving/disagg.py): pages this
+        # engine IMPORTED from a prefill-role replica and the wall ms
+        # those imports cost (scatter dispatch + radix insert). Always
+        # present — 0, never absent, when fleet.disagg is off — and
+        # summed fleet-wide via fleet._COUNTER_KEYS.
+        self.kv_transfer_pages = 0
+        self.kv_transfer_ms = 0.0
         # QoS counters (serving/qos.py; always present — 0, never
         # absent, when engine.qos is off): admissions that failed on
         # page exhaustion (requeued or, past MAX_ADMISSION_RETRIES,
@@ -413,6 +420,8 @@ class EngineMetrics:
                                      if self.spec_slot_steps else 0.0),
             "plan_variants_compiled": self.plan_variants_compiled,
             "spec_fallback_steps": self.spec_fallback_steps,
+            "kv_transfer_pages": self.kv_transfer_pages,
+            "kv_transfer_ms": round(self.kv_transfer_ms, 3),
             "admission_failures": self.admission_failures,
             "qos_preemptions": self.qos_preemptions,
             "stuck_thread_joins": self.stuck_thread_joins,
@@ -640,6 +649,14 @@ class LLMEngine:
         self._wake = threading.Event()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Control ops (serving/disagg.py KV page transfer): closures
+        # queued by other threads via run_control_op() and drained at
+        # the top of the scheduler loop, so the radix tree, allocator
+        # and pool stay scheduler-thread-owned even when the fleet
+        # brokers a cross-replica page transfer. Lock-free append /
+        # popleft (the router-report deque idiom); each entry is
+        # (fn, result_box, done_event).
+        self._control_ops: deque = deque()
         # Chaos slow-replica injection (serving/chaos.py): extra sleep
         # per scheduler iteration. 0.0 (the permanent production value)
         # costs one float compare per beat; written by the chaos thread
@@ -1214,6 +1231,13 @@ class LLMEngine:
             # (a daemon worker mid-write at interpreter exit would
             # race the spill-dir cleanup).
             self.kv_pager.close()
+        # Pending control ops (disagg page transfers) must not strand
+        # their waiters once the scheduler is gone: fail them so the
+        # fleet's transfer path falls back to colocated serving.
+        while self._control_ops:
+            _, box, done = self._control_ops.popleft()
+            box["err"] = RuntimeError("engine stopped")
+            done.set()
 
     # -- public API --------------------------------------------------------
 
@@ -1257,6 +1281,191 @@ class LLMEngine:
     def generate(self, prompt_ids: Sequence[int], **kw) -> str:
         return "".join(ev["text"] for ev in self.generate_stream(prompt_ids, **kw))
 
+    # -- control ops / disagg KV page transfer (serving/disagg.py) ---------
+
+    def run_control_op(self, fn, timeout_s: float = 60.0):
+        """Run `fn()` on the scheduler thread — the single owner of
+        slot, page, allocator and radix-tree state — and return its
+        result. The fleet's KV page transfer rides this seam so a
+        cross-replica export/import never races the scheduler's own
+        tree mutations. Falls back to running inline when the
+        scheduler is not live (tests, warm/parked engines) or when the
+        caller already IS the scheduler thread."""
+        t = self._thread
+        if (not self._running or t is None or not t.is_alive()
+                or threading.current_thread() is t):
+            return fn()
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        self._control_ops.append((fn, box, done))
+        self._wake.set()
+        if not done.wait(timeout_s):
+            raise TimeoutError("engine control op timed out "
+                               f"after {timeout_s}s")
+        if "err" in box:
+            raise box["err"]
+        return box.get("out")
+
+    def _drain_control_ops(self) -> None:
+        """Scheduler thread, loop top: run queued control closures.
+        Errors are boxed back to the waiter, never kill the loop."""
+        while self._control_ops:
+            fn, box, done = self._control_ops.popleft()
+            try:
+                box["out"] = fn()
+            except BaseException as e:  # waiter re-raises
+                box["err"] = e
+            finally:
+                done.set()
+
+    def export_prefix_pages(self, ids: Sequence[int]):
+        """Longest cached full-page prefix of `ids` as HOST bytes —
+        the disagg transfer's source half (serving/disagg.py): one
+        batched pool_to_pages gather for the device-resident run,
+        plus (with engine.kv_pager) a tier-lock read of any demoted
+        tail, codes + int8 scales VERBATIM so a transfer round trip
+        is bit-identical to never having left this pool. Returns
+        (codes [n,2,L,KH,ps,Hd], scales [n,2,L,KH,ps]|None, n_tokens)
+        or None when nothing is cached. Scheduler thread only — the
+        fleet calls in via run_control_op. The blocking device->host
+        fetch is by design: it IS the transfer cost the bench meters.
+        """
+        from generativeaiexamples_tpu.serving.disagg import page_geometry
+        from generativeaiexamples_tpu.serving.prefix_cache import (
+            TIER_DEVICE, TIER_DISK, TIER_HOST)
+
+        if self.prefix_cache is None:
+            return None
+        nodes = self.prefix_cache.match_nodes(list(ids))
+        if not nodes:
+            return None
+        # Resident prefix first (the resident set is ancestor-closed),
+        # then — with the pager — the demoted tail straight from its
+        # cold tier, no promotion dispatch. A TIER_PENDING node ends
+        # the run (its bytes are mid-flight to the host).
+        dev: List = []
+        for n in nodes:
+            if n.tier != TIER_DEVICE:
+                break
+            dev.append(n)
+        cold: List = []
+        if self.kv_pager is not None:
+            for n in nodes[len(dev):]:
+                if n.tier not in (TIER_HOST, TIER_DISK):
+                    break
+                cold.append(n)
+        n_pages = len(dev) + len(cold)
+        if n_pages == 0:
+            return None
+        codes_shape, codes_dtype, scales_shape = page_geometry(self.pool)
+        codes = np.zeros((n_pages,) + codes_shape, codes_dtype)
+        scales = (np.zeros((n_pages,) + scales_shape, np.float32)
+                  if scales_shape else None)
+        if dev:
+            w = 1
+            while w < len(dev):
+                w *= 2
+            row = np.zeros((w,), np.int32)  # padding -> sink page 0
+            row[: len(dev)] = [n.page for n in dev]
+            got, got_s = engine_model.pool_to_pages(self.pool,
+                                                    self._put(row))
+            codes[: len(dev)] = np.asarray(got)[: len(dev)]
+            if scales is not None:
+                scales[: len(dev)] = np.asarray(got_s)[: len(dev)]
+        if cold:
+            self.kv_pager.read_pages(
+                cold, codes[len(dev):],
+                None if scales is None else scales[len(dev):])
+        return codes, scales, n_pages * self.pool.page_size
+
+    def import_prefix_pages(self, ids: Sequence[int], codes: np.ndarray,
+                            scales: Optional[np.ndarray]) -> int:
+        """Seat transferred page bytes into this engine's pool and
+        radix tree — the disagg transfer's target half: allocate pool
+        pages (reclaim may demote cold sessions, exactly like a
+        promote), ONE pages_to_pool scatter, then insert the prefix
+        into the tree so the very next admission takes the normal
+        prefix-cache hit path (zero re-prefill of the transferred
+        prefix). Returns pages imported (0 when the prefix is already
+        resident); raises MemoryError when the allocator cannot cover
+        the pages even after reclaim (the fleet falls back to
+        colocated serving). Scheduler thread only — run_control_op."""
+        from generativeaiexamples_tpu.serving.prefix_cache import (
+            TIER_DEVICE)
+
+        if self.prefix_cache is None:
+            raise RuntimeError("KV import needs engine.prefix_cache")
+        ps = self.pool.page_size
+        n = min(int(codes.shape[0]), len(ids) // ps)
+        if n <= 0:
+            return 0
+
+        def resident_run(upto_pages: int) -> List:
+            out = []
+            for node in self.prefix_cache.match_nodes(
+                    list(ids[: upto_pages * ps])):
+                if node.tier != TIER_DEVICE:
+                    break
+                out.append(node)
+            return out
+
+        # Import only the NON-resident suffix: a growing multi-turn
+        # prefix re-ships every turn, and allocating pages for chunks
+        # the tree already holds can reclaim-evict hot cache (or fail
+        # a transfer that only needed the tail).
+        have = len(resident_run(n))
+        if have >= n:
+            return 0  # already resident: the hit path serves as-is
+        t0 = time.perf_counter()
+        m = n - have
+        pages = self.allocator.alloc(m)
+        try:
+            if have and len(resident_run(have)) < have:
+                # The alloc's reclaim evicted part of the resident
+                # prefix out from under us: the suffix would link
+                # under missing ancestors. Rare (hard pool pressure);
+                # the fleet falls back to colocated serving.
+                raise MemoryError(
+                    "resident prefix evicted during import alloc")
+            w = 1
+            while w < m:
+                w *= 2
+            buf = np.zeros((w,) + codes.shape[1:], codes.dtype)
+            buf[:m] = codes[have:n]
+            row = np.zeros((w,), np.int32)  # padding -> sink page 0
+            row[:m] = pages
+            sbuf = None
+            if scales is not None:
+                sbuf = np.zeros((w,) + scales.shape[1:], np.float32)
+                sbuf[:m] = scales[have:n]
+            self.pool = engine_model.pages_to_pool(
+                self.pool, self._put(buf),
+                None if sbuf is None else self._put(sbuf),
+                self._put(row))
+            # The leading `have` chunks are guaranteed present (just
+            # re-verified, nothing evicts between here and insert on
+            # this thread), so insert dedups them — their payloads
+            # are never adopted, only the fresh suffix pages are.
+            lead = [nd.page for nd in resident_run(have)]
+            self.prefix_cache.insert(list(ids[: n * ps]),
+                                     lead + list(pages))
+            freed = self.prefix_cache.trim()
+            if freed:
+                self.metrics.prefix_evictions += freed
+        finally:
+            # The tree retained its own references at insert; suffix
+            # chunks that raced into the cache keep their existing
+            # node and this release frees the duplicate page.
+            self.allocator.release(pages)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.kv_transfer_pages += m
+        self.metrics.kv_transfer_ms += dt_ms
+        self.metrics.hists["kv_transfer_ms_per_page"].observe(dt_ms / m)
+        if self.flight.enabled:
+            self.flight.record_event(EV_KV_TRANSFER, t0, a=float(m),
+                                     b=dt_ms)
+        return m
+
     # -- scheduler ---------------------------------------------------------
 
     def _free_slot_index(self) -> Optional[int]:
@@ -1295,6 +1504,7 @@ class LLMEngine:
                 # Injected slow-replica latency (chaos harness only;
                 # 0.0 in production, one compare per iteration).
                 time.sleep(self.chaos_beat_delay_s)
+            self._drain_control_ops()
             did_work = self._admit_waiting()
             # Chunk forwards interleave with decode dispatches (paced
             # by the landed-block beat) instead of monopolizing the

@@ -79,6 +79,10 @@ _COUNTER_KEYS = (
     "prefix_miss", "prefix_evictions", "prefix_hit_tokens",
     "plan_variants_compiled", "spec_fallback_steps",
     "admission_failures", "qos_preemptions",
+    # Disagg KV transfer counters (serving/disagg.py): pages a decode
+    # replica imported from a prefill-role replica, and the wall ms
+    # those imports cost — summed fleet-wide, zeros when disagg is off.
+    "kv_transfer_pages", "kv_transfer_ms",
     # KV-pager counters and tier gauges (serving/kv_pager.py) sum
     # across replicas: fleet-wide parked-session pages per tier.
     "kv_demotions", "kv_promotions", "kv_promote_tokens",
@@ -100,6 +104,10 @@ _COUNTER_KEYS = (
 FLEET_OPS_KEYS = (
     "autoscale_ups", "autoscale_downs", "autoscale_wakes",
     "upgrade_rolls", "upgrade_replicas_rolled",
+    # Disagg control plane (serving/disagg.py): two-stage plans the
+    # fleet ran, and stages that fell back to colocated serving on the
+    # same stream (prefill failure, transfer failure, empty export).
+    "disagg_requests", "disagg_fallbacks",
 )
 
 # Chaos-injection counters (serving/chaos.py ChaosStats): zeros unless
@@ -123,6 +131,8 @@ class FleetOps:
         self.autoscale_wakes = 0
         self.upgrade_rolls = 0
         self.upgrade_replicas_rolled = 0
+        self.disagg_requests = 0
+        self.disagg_fallbacks = 0
         self.stuck_thread_joins = 0
 
     def note_scale_up(self) -> None:
@@ -141,6 +151,14 @@ class FleetOps:
         with self._lock:
             self.upgrade_rolls += 1
             self.upgrade_replicas_rolled += replicas
+
+    def note_disagg(self) -> None:
+        with self._lock:
+            self.disagg_requests += 1
+
+    def note_disagg_fallback(self) -> None:
+        with self._lock:
+            self.disagg_fallbacks += 1
 
     def note_stuck_join(self, n: int = 1) -> None:
         with self._lock:
@@ -187,9 +205,12 @@ class LocalReplica:
     # into a stream the fleet re-places.
     supports_requeue = True
 
-    def __init__(self, rid: str, engine):
+    def __init__(self, rid: str, engine, role: str = "mixed"):
         self.rid = rid
         self.engine = engine
+        # Disagg role (router.REPLICA_ROLES): "prefill" replicas only
+        # ever see prefill stages, never decode placements.
+        self.role = role
         # Fleet-owned state machine: active | draining | drained |
         # evicted | warm (started+warmed, not admitting — the
         # autoscaler's instant-scale-up pool) | parked (cold-stopped —
@@ -260,6 +281,27 @@ class LocalReplica:
     def metrics_snapshot(self) -> Dict[str, Any]:
         return self.engine.metrics.snapshot()
 
+    # -- disagg KV page transfer (serving/disagg.py) -----------------------
+
+    # graftlint: hot-path
+    def export_kv_pages(self, ids, timeout_s: float = 60.0):
+        """Cached full-page prefix of `ids` as host bytes, gathered on
+        the engine's scheduler thread (control op). None when nothing
+        is cached."""
+        eng = self.engine
+        return eng.run_control_op(
+            lambda: eng.export_prefix_pages(ids), timeout_s=timeout_s)
+
+    # graftlint: hot-path
+    def import_kv_pages(self, ids, codes, scales,
+                        timeout_s: float = 60.0) -> int:
+        """Seat transferred pages into the engine's pool + radix tree
+        (control op). Returns pages imported."""
+        eng = self.engine
+        return eng.run_control_op(
+            lambda: eng.import_prefix_pages(ids, codes, scales),
+            timeout_s=timeout_s)
+
 
 class HttpReplica:
     """One remote engine-server process as a fleet replica (the
@@ -279,8 +321,9 @@ class HttpReplica:
     supports_requeue = False
 
     def __init__(self, rid: str, base_url: str, timeout_s: float = 300.0,
-                 probe_timeout_s: float = 2.0):
+                 probe_timeout_s: float = 2.0, role: str = "mixed"):
         self.rid = rid
+        self.role = role
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         # Health probes get their OWN short connect/read timeout — a
@@ -379,6 +422,41 @@ class HttpReplica:
                 return json.load(resp)
         except Exception as e:
             return {"error": f"{type(e).__name__}: {e}"}
+
+    # -- disagg KV page transfer (serving/disagg.py over HTTP) -------------
+
+    # graftlint: hot-path
+    def export_kv_pages(self, ids, timeout_s: float = 60.0):
+        """Fetch the remote replica's cached prefix for `ids` over its
+        /v1/kv/export endpoint. None when it holds nothing (204)."""
+        from generativeaiexamples_tpu.serving.disagg import (
+            deserialize_kv_transfer)
+
+        body = json.dumps({"prompt": list(ids)}).encode()
+        http_req = urllib.request.Request(
+            self.base_url + "/v1/kv/export", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(http_req, timeout=timeout_s) as resp:
+            payload = resp.read()
+        if not payload:
+            return None
+        got_ids, codes, scales = deserialize_kv_transfer(payload)
+        return codes, scales, len(got_ids)
+
+    # graftlint: hot-path
+    def import_kv_pages(self, ids, codes, scales,
+                        timeout_s: float = 60.0) -> int:
+        """Ship pages to the remote replica's /v1/kv/import endpoint.
+        Returns pages the remote engine imported."""
+        from generativeaiexamples_tpu.serving.disagg import (
+            serialize_kv_transfer)
+
+        http_req = urllib.request.Request(
+            self.base_url + "/v1/kv/import",
+            data=serialize_kv_transfer(list(ids), codes, scales),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(http_req, timeout=timeout_s) as resp:
+            return int(json.load(resp).get("pages", 0))
 
 
 class _ReqRecord:
@@ -555,11 +633,34 @@ class EngineFleet:
                  load_penalty_tokens: int = 256,
                  shadow_capacity_pages: int = 4096,
                  health_interval_s: float = 0.0,
-                 health_fail_threshold: int = 3):
+                 health_fail_threshold: int = 3,
+                 replica_roles: Optional[Dict[str, str]] = None,
+                 disagg: bool = False,
+                 disagg_min_prompt_tokens: int = 0,
+                 disagg_prefill_timeout_s: float = 120.0,
+                 disagg_transfer_timeout_s: float = 60.0):
         if not replicas:
             raise ValueError("EngineFleet needs at least one replica")
         self.replicas = list(replicas)
         self.tokenizer = tokenizer
+        # Disagg (serving/disagg.py): role map overrides replica-object
+        # roles; with disagg on, submit() runs the two-stage plan when
+        # a prefill-role replica admits, colocated otherwise.
+        for r in self.replicas:
+            role = (replica_roles or {}).get(r.rid)
+            if role is not None:
+                r.role = role
+        self.disagg = bool(disagg)
+        self._disagg_min_prompt_tokens = max(0,
+                                             int(disagg_min_prompt_tokens))
+        self._disagg_prefill_timeout_s = float(disagg_prefill_timeout_s)
+        self._disagg_transfer = None
+        if self.disagg:
+            from generativeaiexamples_tpu.serving.disagg import (
+                KVPageTransfer)
+
+            self._disagg_transfer = KVPageTransfer(
+                timeout_s=disagg_transfer_timeout_s)
         self.router = PrefixLocalityRouter(
             page_size, policy=router_policy, affinity_ttl_s=affinity_ttl_s,
             load_penalty_tokens=load_penalty_tokens,
@@ -600,7 +701,8 @@ class EngineFleet:
         self._probe_errors = 0
         for r in self.replicas:
             self.router.add_replica(
-                r.rid, self_feed=not getattr(r, "has_prefix_cache", False))
+                r.rid, self_feed=not getattr(r, "has_prefix_cache", False),
+                role=getattr(r, "role", "mixed"))
             r.set_reporter(self.router.reporter_for(r.rid))
 
     # -- engine-shaped surface (what OpenAIServer consumes) ----------------
@@ -652,7 +754,40 @@ class EngineFleet:
     def submit(self, req):  # graftlint: hot-path
         """Place and dispatch one request. Raises FleetUnavailableError
         when no replica admits; replica submit errors (e.g.
-        PromptTooLongError) propagate after the tracking is unwound."""
+        PromptTooLongError) propagate after the tracking is unwound.
+        With fleet.disagg on, the router may emit a two-stage plan:
+        prefill on a prefill-role replica, KV pages transferred, then
+        the decode dispatch below resumes from the transferred prefix
+        via the normal prefix-cache hit path."""
+        if self.disagg and \
+                len(req.prompt_ids) >= self._disagg_min_prompt_tokens:
+            plan = self.router.place_disagg(req.prompt_ids,
+                                            getattr(req, "session_id",
+                                                    ""))
+            if plan is not None:
+                prid, drid = plan
+                if prid:
+                    from generativeaiexamples_tpu.serving.qos import (
+                        request_tier)
+
+                    # Reserve the decode replica's load for the stage
+                    # window: prefill + transfer take seconds, and
+                    # without the reservation concurrent disagg
+                    # placements would all score the same "idle"
+                    # decode replica (the non-disagg path's
+                    # place->note_submitted gap is microseconds).
+                    est = max(1, int(getattr(req, "max_new_tokens", 1)
+                                     or 1))
+                    tier = request_tier(req)
+                    self.router.note_submitted(drid, est, tier)
+                    try:
+                        # Any failure already fell back (counted) —
+                        # the decode dispatch serves the stream either
+                        # way, colocated at worst.
+                        self._run_disagg_stages(prid, drid, req)
+                    finally:
+                        self.router.note_finished(drid, est, tier)
+                return self._dispatch_to(drid, req)
         try:
             rid = self.router.place(req.prompt_ids,
                                     getattr(req, "session_id", ""))
@@ -668,6 +803,13 @@ class EngineFleet:
                                         getattr(req, "session_id", ""))
             except LookupError as e2:
                 raise FleetUnavailableError(str(e2)) from e2
+        return self._dispatch_to(rid, req)
+
+    # graftlint: hot-path
+    def _dispatch_to(self, rid: str, req):
+        """Track + dispatch one placed request onto replica `rid`
+        (the post-placement half of submit(), shared with the disagg
+        decode stage)."""
         rec = _ReqRecord(req, rid)
         req.stream = _TrackedStream(self, rec)
         with self._lock:
@@ -736,6 +878,95 @@ class EngineFleet:
             else:
                 self._requeue(rec)
         return req
+
+    # -- disaggregated prefill/decode (serving/disagg.py) ------------------
+
+    # graftlint: hot-path
+    def _run_disagg_stages(self, prid: str, drid: str, req) -> bool:
+        """Prefill `req`'s prompt on the prefill-role replica `prid`,
+        then ship the finished KV pages to the decode replica `drid`
+        (host-bounce via KVPageTransfer). Returns True when the
+        decode replica holds the prefix afterwards; False means the
+        caller's decode dispatch serves COLOCATED on the same stream
+        (counted in disagg_fallbacks) — disagg never fails a request
+        that colocated serving would have carried."""
+        self.ops.note_disagg()
+        ok = False
+        try:
+            if self._disagg_prefill(prid, req):
+                pages, ms = self._disagg_transfer.transfer(
+                    self._by_rid[prid], self._by_rid[drid],
+                    list(req.prompt_ids))
+                # 0 pages without an exception: the source cached
+                # nothing (falls back) — import returning 0 because
+                # the target already holds the prefix was filtered by
+                # place_disagg's shadow check.
+                ok = pages > 0
+        except Exception as e:
+            _LOG.warning("disagg transfer %s->%s failed; serving "
+                         "colocated: %s", prid, drid, e)
+        if not ok:
+            self.ops.note_disagg_fallback()
+        return ok
+
+    # graftlint: hot-path
+    def _disagg_prefill(self, prid: str, req) -> bool:
+        """Run the prefill stage: an internal single-token greedy
+        request on the prefill replica populates its radix prefix
+        cache with the prompt's full pages (the normal completed-
+        prefill insert path). Blocks until the stage finishes or the
+        timeout; the stage's one sampled token is discarded — the
+        client's first token comes from the decode replica's suffix
+        prefill, so streams stay byte-identical to colocated greedy."""
+        from generativeaiexamples_tpu.serving.engine import GenRequest
+        from generativeaiexamples_tpu.serving.qos import request_tier
+
+        stage = GenRequest(
+            prompt_ids=list(req.prompt_ids), max_new_tokens=1,
+            temperature=0.0,
+            priority=getattr(req, "priority", "standard"),
+            tenant_id=getattr(req, "tenant_id", ""),
+            request_id=(req.request_id + "-prefill"
+                        if getattr(req, "request_id", "") else ""))
+        tier = request_tier(stage)
+        replica = self._by_rid[prid]
+        self.router.note_submitted(prid, 1, tier)
+        try:
+            replica.submit(stage)
+            deadline = time.monotonic() + self._disagg_prefill_timeout_s
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    # Abandoned: cancel so the prefill engine retires
+                    # the stage instead of decoding for nobody.
+                    stage.cancelled = True
+                    return False
+                if replica.state in ("evicted", "parked"):
+                    # The stage request is fleet-internal (no
+                    # _ReqRecord), so evict()/park() deliver it no
+                    # terminal event — bail out NOW instead of
+                    # spinning out the full prefill timeout.
+                    stage.cancelled = True
+                    return False
+                try:
+                    ev = stage.stream.get(timeout=min(left, 0.25))
+                except queue.Empty:
+                    continue
+                if ev.get("finished"):
+                    return ev.get("finish_reason") != "error"
+        except Exception as e:
+            _LOG.warning("disagg prefill stage on %s failed: %s",
+                         prid, e)
+            return False
+        finally:
+            self.router.note_finished(prid, 1, tier)
+
+    def set_replica_role(self, rid: str, role: str) -> None:
+        """Flip one replica's disagg role at runtime (autoscaler: a
+        spawned replica joins the pool that is under pressure)."""
+        with self._lock:
+            self._by_rid[rid].role = role
+        self.router.set_role(rid, role)
 
     def start(self) -> "EngineFleet":
         for r in self.replicas:
@@ -825,10 +1056,15 @@ class EngineFleet:
             self._health_fails.pop(rid, None)
         self.router.set_admitting(rid, True)
 
-    def add_replica(self, replica, admitting: bool = True) -> None:
+    def add_replica(self, replica, admitting: bool = True,
+                    role: Optional[str] = None) -> None:
         """Register a replica at RUNTIME (the autoscaler's spawn
         path): joins the router with a fresh shadow; admitting=False
-        parks it straight into the warm pool."""
+        parks it straight into the warm pool. `role` assigns a disagg
+        role (default: whatever the replica object carries, "mixed"
+        otherwise)."""
+        if role is not None:
+            replica.role = role
         with self._lock:
             if replica.rid in self._by_rid:
                 raise ValueError(f"duplicate replica id {replica.rid!r}")
@@ -838,7 +1074,8 @@ class EngineFleet:
             replica.state = "active" if admitting else "warm"
         self.router.add_replica(
             replica.rid,
-            self_feed=not getattr(replica, "has_prefix_cache", False))
+            self_feed=not getattr(replica, "has_prefix_cache", False),
+            role=getattr(replica, "role", "mixed"))
         replica.set_reporter(self.router.reporter_for(replica.rid))
         if not admitting:
             self.router.set_admitting(replica.rid, False)
@@ -1141,16 +1378,28 @@ class EngineFleet:
             replicas = {
                 r.rid: {
                     "state": r.state,
+                    "role": getattr(r, "role", "mixed"),
                     "draining": r.state == "draining",
                     "queue_depth": depths.get(r.rid, 0),
                     "probe_fails": self._health_fails.get(r.rid, 0),
                 } for r in self.replicas}
             probe_errors = self._probe_errors
         scaler = self.autoscaler
+        ops = self.ops.snapshot()
         return {"enabled": True, "replicas": replicas,
                 "router_policy": self.router.policy,
                 "probe_errors": probe_errors,
                 "health_fail_threshold": self._health_fail_threshold,
+                # Always-present disagg subsection (enabled false,
+                # zeros, when fleet.disagg is off — the counter
+                # convention): plans emitted, two-stage runs, and
+                # colocated fallbacks.
+                "disagg": {
+                    "enabled": self.disagg,
+                    "plans": self.router.router_disagg_plans,
+                    "requests": ops["disagg_requests"],
+                    "fallbacks": ops["disagg_fallbacks"],
+                },
                 "autoscale": (scaler.health() if scaler is not None
                               else {"enabled": False}),
                 "chaos": {"enabled": self.chaos_stats is not None}}
@@ -1177,6 +1426,13 @@ def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None,
                                     probe_timeout_s=fcfg.probe_timeout_s))
     if tokenizer is None:
         raise ValueError("remote-only fleet needs an explicit tokenizer")
+    # Positional role list ("prefill,decode,..."): entry i tags
+    # replica i (locals first, then remotes); unlisted replicas stay
+    # "mixed". The router rejects unknown role names at add time.
+    roles = [x.strip() for x in (fcfg.replica_roles or "").split(",")
+             if x.strip()]
+    role_map = {r.rid: roles[i] for i, r in enumerate(replicas)
+                if i < len(roles)}
     page_size = engines[0].ecfg.page_size if engines else \
         cfg.engine.page_size
     fleet = EngineFleet(
@@ -1186,7 +1442,12 @@ def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None,
         load_penalty_tokens=fcfg.load_penalty_tokens,
         shadow_capacity_pages=fcfg.shadow_capacity_pages,
         health_interval_s=fcfg.health_interval_s,
-        health_fail_threshold=fcfg.health_fail_threshold)
+        health_fail_threshold=fcfg.health_fail_threshold,
+        replica_roles=role_map,
+        disagg=fcfg.disagg,
+        disagg_min_prompt_tokens=fcfg.disagg_min_prompt_tokens,
+        disagg_prefill_timeout_s=fcfg.disagg_prefill_timeout_s,
+        disagg_transfer_timeout_s=fcfg.disagg_transfer_timeout_s)
     if fcfg.autoscale:
         from generativeaiexamples_tpu.serving.autoscaler import (
             FleetAutoscaler)
@@ -1202,7 +1463,9 @@ def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None,
             up_ticks=fcfg.autoscale_up_ticks,
             down_ticks=fcfg.autoscale_down_ticks,
             cooldown_s=fcfg.autoscale_cooldown_s,
-            scale_to_zero=fcfg.autoscale_scale_to_zero)
+            scale_to_zero=fcfg.autoscale_scale_to_zero,
+            up_queue_wait_p95_ms=fcfg.autoscale_up_queue_wait_p95_ms,
+            up_ttft_p95_ms=fcfg.autoscale_up_ttft_p95_ms)
     if fcfg.chaos:
         from generativeaiexamples_tpu.serving.chaos import ChaosMonkey
 

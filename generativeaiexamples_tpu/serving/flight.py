@@ -66,6 +66,12 @@ EV_SCALE_DOWN = 14     # autoscaler parked a replica (warm/cold)
 EV_SCALE_WAKE = 15     # submit-time wake of a parked fleet (a = 1)
 EV_UPGRADE = 16        # one replica rolled (b = drain+swap ms)
 EV_CHAOS = 17          # chaos injection (aux = "<kind>:<rid>")
+# Disaggregated prefill/decode (serving/disagg.py): KV pages imported
+# from a prefill-role replica (a = pages, b = import ms). Written by
+# the IMPORTING engine's scheduler thread (the transfer runs as a
+# control op), so the single-writer ring invariant holds and the
+# analyzer attributes the beat gap it causes to "disagg".
+EV_KV_TRANSFER = 18
 
 EVENT_NAMES = {
     EV_SUBMIT: "submit", EV_QOS_PICK: "qos_pick", EV_ADMIT: "admit",
@@ -76,7 +82,7 @@ EVENT_NAMES = {
     EV_KV_PROMOTE: "kv_promote", EV_KV_DEMOTE: "kv_demote",
     EV_SCALE_UP: "scale_up", EV_SCALE_DOWN: "scale_down",
     EV_SCALE_WAKE: "scale_wake", EV_UPGRADE: "upgrade",
-    EV_CHAOS: "chaos",
+    EV_CHAOS: "chaos", EV_KV_TRANSFER: "kv_transfer",
 }
 
 # Retire reason codes (EV_RETIRE.code); anything unknown maps to -1.
@@ -85,8 +91,8 @@ RETIRE_NAMES = {v: k for k, v in RETIRE_CODES.items()}
 
 # Gap-cause instants the analyzer attributes host gaps to, in priority
 # order (a gap containing several causes is charged to the first).
-GAP_CAUSE_KINDS = (EV_QOS_PAUSE, EV_KV_PROMOTE, EV_ADMIT_RETRY,
-                   EV_PREFILL_CHUNK, EV_KV_DEMOTE)
+GAP_CAUSE_KINDS = (EV_QOS_PAUSE, EV_KV_PROMOTE, EV_KV_TRANSFER,
+                   EV_ADMIT_RETRY, EV_PREFILL_CHUNK, EV_KV_DEMOTE)
 
 # Fleet control-plane instants: rendered on the timeline (cat "fleet",
 # so a TTFT spike can be eyeballed against the scale/upgrade/chaos
@@ -136,6 +142,7 @@ HIST_KEYS = (
     "hist_queue_wait_ms_latency", "hist_queue_wait_ms_standard",
     "hist_queue_wait_ms_batch",
     "hist_beat_gap_ms", "hist_kv_promote_ms_per_page",
+    "hist_kv_transfer_ms_per_page",
 )
 
 

@@ -122,6 +122,12 @@ class OpenAIServer:
             web.post("/v1/ranking", self.handle_ranking),
             web.get("/metrics", self.handle_metrics),
             web.get("/debug/timeline", self.handle_timeline),
+            # Disagg KV page transfer (serving/disagg.py): replica
+            # engine-server processes expose their prefix cache so an
+            # HttpReplica fleet can move finished prefills' pages
+            # between processes (fleet.py HttpReplica.export/import).
+            web.post("/v1/kv/export", self.handle_kv_export),
+            web.post("/v1/kv/import", self.handle_kv_import),
         ])
 
     # -- helpers -----------------------------------------------------------
@@ -328,6 +334,75 @@ class OpenAIServer:
         trace = await loop.run_in_executor(
             self._executor, lambda: chrome_trace(self._flight_lanes()))
         return web.json_response(trace)
+
+    async def handle_kv_export(self, request: web.Request) -> web.Response:
+        """Disagg transfer source: the cached full-page prefix of the
+        posted prompt (token ids) as a kv-transfer payload; 204 when
+        nothing is cached. Served by replica engine-server processes
+        — a fleet-fronting router has no single pool to export (501).
+        The export runs as an engine control op (scheduler thread),
+        bridged through the executor so the gather's blocking host
+        fetch never stalls the event loop."""
+        eng = self.llm
+        if eng is None or not hasattr(eng, "export_prefix_pages"):
+            return web.json_response(
+                {"error": "no engine-level KV surface"}, status=501)
+        from generativeaiexamples_tpu.serving.disagg import (
+            serialize_kv_transfer)
+
+        body = await request.json()
+        ids = list(body.get("prompt") or [])
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                self._executor,
+                lambda: eng.run_control_op(
+                    lambda: eng.export_prefix_pages(ids)))
+        except Exception as e:
+            _LOG.warning("kv export failed: %s", e)
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "service_unavailable",
+                           "code": "kv_export_failed"}}, status=503)
+        if out is None:
+            return web.Response(status=204)
+        codes, scales, n_tokens = out
+        return web.Response(
+            body=serialize_kv_transfer(ids[:n_tokens], codes, scales),
+            content_type="application/octet-stream")
+
+    async def handle_kv_import(self, request: web.Request) -> web.Response:
+        """Disagg transfer target: seat a kv-transfer payload's pages
+        into this engine's pool + radix tree; responds {"pages": n}.
+        Failures (pool pressure, stopped engine) are 503 — the fleet
+        falls back to colocated serving."""
+        eng = self.llm
+        if eng is None or not hasattr(eng, "import_prefix_pages"):
+            return web.json_response(
+                {"error": "no engine-level KV surface"}, status=501)
+        from generativeaiexamples_tpu.serving.disagg import (
+            deserialize_kv_transfer)
+
+        buf = await request.read()
+        loop = asyncio.get_running_loop()
+        try:
+            ids, codes, scales = deserialize_kv_transfer(buf)
+            pages = await loop.run_in_executor(
+                self._executor,
+                lambda: eng.run_control_op(
+                    lambda: eng.import_prefix_pages(ids, codes, scales)))
+        except ValueError as e:  # bad payload
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error",
+                           "code": "bad_kv_payload"}}, status=422)
+        except Exception as e:
+            _LOG.warning("kv import failed: %s", e)
+            return web.json_response(
+                {"error": {"message": str(e),
+                           "type": "service_unavailable",
+                           "code": "kv_import_failed"}}, status=503)
+        return web.json_response({"pages": int(pages)})
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         return await self._generate(request, chat=True)

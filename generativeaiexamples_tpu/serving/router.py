@@ -73,8 +73,15 @@ _OVERLOAD_SLACK = 4
 ROUTER_COUNTER_KEYS = (
     "router_requests", "router_prefix_hits", "router_hit_tokens",
     "router_affinity_hits", "router_rebalances", "replica_evictions",
-    "router_requeued",
+    "router_requeued", "router_disagg_plans",
 )
+
+# Replica roles (fleet.replica_roles / serving/disagg.py): a
+# "prefill"-role replica runs prefill stages only and NEVER receives
+# decode placements; "decode" and "mixed" replicas serve normal
+# traffic. With no prefill-role replicas the fleet is colocated and
+# placement is byte-identical to the role-less router.
+REPLICA_ROLES = ("prefill", "decode", "mixed")
 
 
 class ShadowRadixTree(RadixTree):
@@ -129,11 +136,14 @@ class ReplicaState:
     calls in with its own state transitions)."""
 
     def __init__(self, rid: str, page_size: int, shadow_capacity: int,
-                 self_feed: bool):
+                 self_feed: bool, role: str = "mixed"):
         self.rid = rid
         self.shadow = ShadowRadixTree(page_size, shadow_capacity)
         # Replica admits new placements (False while draining/evicted).
         self.admitting = True
+        # Disagg role (REPLICA_ROLES): "prefill" keeps this replica
+        # out of decode placement entirely.
+        self.role = role
         # Live requests routed here and not yet finished, and their
         # undelivered token budget (the in-flight token load signal).
         self.inflight = 0
@@ -182,13 +192,28 @@ class PrefixLocalityRouter:
         self.router_rebalances = 0
         self.replica_evictions = 0
         self.router_requeued = 0
+        self.router_disagg_plans = 0
 
     # -- replica registry (fleet calls; state transitions) -----------------
 
-    def add_replica(self, rid: str, self_feed: bool) -> None:
+    def add_replica(self, rid: str, self_feed: bool,
+                    role: str = "mixed") -> None:
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"unknown replica role {role!r}")
         with self._lock:
             self._replicas[rid] = ReplicaState(
-                rid, self.page_size, self.shadow_capacity_pages, self_feed)
+                rid, self.page_size, self.shadow_capacity_pages, self_feed,
+                role=role)
+
+    def set_role(self, rid: str, role: str) -> None:
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"unknown replica role {role!r}")
+        with self._lock:
+            self._replicas[rid].role = role
+
+    def roles(self) -> Dict[str, str]:
+        with self._lock:
+            return {rid: st.role for rid, st in self._replicas.items()}
 
     def reporter_for(self, rid: str):
         """Admission/eviction report sink for one replica's radix cache
@@ -303,31 +328,80 @@ class PrefixLocalityRouter:
 
     def place(self, ids: Sequence[int], session: str = "") -> str:  # graftlint: hot-path
         """Pick the replica for a prompt. Raises LookupError when no
-        replica admits (the fleet maps it to 503)."""
-        now = time.monotonic()
+        replica admits (the fleet maps it to 503). Prefill-role
+        replicas (disagg) are never decode candidates — they only see
+        the prefill stages place_disagg hands them."""
         with self._lock:
             for st in self._replicas.values():
                 self._apply_reports(st)
-            cands = [st for st in self._replicas.values() if st.admitting]
+            cands = [st for st in self._replicas.values()
+                     if st.admitting and st.role != "prefill"]
             if not cands:
-                raise LookupError("no admitting replica")
-            self.router_requests += 1
-            chosen, matched = self._choose(cands, ids, session, now)
-            if session:
-                if len(self._affinity) > 65536:  # TTL-expired entries
-                    self._affinity = {k: v for k, v in
-                                      self._affinity.items() if v[1] > now}
-                self._affinity[session] = (chosen.rid,
-                                           now + self.affinity_ttl_s)
-            if chosen.self_feed:
-                # No real cache on the replica: shadow what it WOULD
-                # cache so repeats still converge.
-                chosen.shadow.insert(ids)
-                chosen.shadow.trim()
-            if matched > 0:
-                self.router_prefix_hits += 1
-                self.router_hit_tokens += matched
-            return chosen.rid
+                raise LookupError("no admitting decode-capable replica")
+            return self._place_locked(cands, ids, session)
+
+    # graftlint: hot-path
+    def place_disagg(self, ids: Sequence[int], session: str = ""):
+        """Two-stage disagg plan: (prefill_rid, decode_rid). The
+        decode replica is chosen by the NORMAL scoring (affinity,
+        locality, load) over decode-capable replicas — placement
+        bookkeeping included, so the caller must NOT call place()
+        again for this request — and the prefill stage goes to the
+        least-pressured prefill-role replica. Returns
+
+        - (prefill_rid, decode_rid): run the two-stage path;
+        - ("", decode_rid): serve colocated on decode_rid (the decode
+          replica already shadows the full-page prefix, or the prompt
+          has no full page — a transfer would move nothing);
+        - None: no admitting prefill-role AND decode-capable split
+          exists; the caller uses plain place().
+        """
+        full = (len(ids) // self.page_size) * self.page_size
+        with self._lock:
+            for st in self._replicas.values():
+                self._apply_reports(st)
+            prefills = [st for st in self._replicas.values()
+                        if st.admitting and st.role == "prefill"]
+            decodes = [st for st in self._replicas.values()
+                       if st.admitting and st.role != "prefill"]
+            if not prefills or not decodes:
+                return None
+            # Shadow coverage BEFORE placement bookkeeping: a
+            # self-feeding decode shadow absorbs this very prompt
+            # inside _place_locked, which would read as full coverage.
+            pre = {st.rid: st.shadow.match_tokens(ids) for st in decodes}
+            drid = self._place_locked(decodes, ids, session)
+            if full <= 0 or pre[drid] >= full:
+                return "", drid
+            prid = min(prefills,
+                       key=lambda s: (self._tier_pressure(s),
+                                      s.pending_tokens, s.rid)).rid
+            self.router_disagg_plans += 1
+            return prid, drid
+
+    def _place_locked(self, cands: List[ReplicaState],
+                      ids: Sequence[int], session: str) -> str:
+        """Lock held. Score + pick over `cands` with full placement
+        bookkeeping (request count, affinity pin, self-feed, prefix-
+        hit counters) — shared by place() and place_disagg()."""
+        now = time.monotonic()
+        self.router_requests += 1
+        chosen, matched = self._choose(cands, ids, session, now)
+        if session:
+            if len(self._affinity) > 65536:  # TTL-expired entries
+                self._affinity = {k: v for k, v in
+                                  self._affinity.items() if v[1] > now}
+            self._affinity[session] = (chosen.rid,
+                                       now + self.affinity_ttl_s)
+        if chosen.self_feed:
+            # No real cache on the replica: shadow what it WOULD
+            # cache so repeats still converge.
+            chosen.shadow.insert(ids)
+            chosen.shadow.trim()
+        if matched > 0:
+            self.router_prefix_hits += 1
+            self.router_hit_tokens += matched
+        return chosen.rid
 
     def _choose(self, cands: List[ReplicaState], ids: Sequence[int],
                 session: str, now: float) -> tuple:

@@ -44,13 +44,14 @@ from typing import Any, Dict, List, Optional, Tuple
 CAUSE_PRIORITY = (
     ("qos_pause", "qos_pause"),
     ("kv_promote", "pager_gather"),
+    ("kv_transfer", "disagg"),
     ("admission_retry", "admission_retry"),
     ("prefill_chunk", "prefill_chunk"),
     ("kv_demote", "kv_demote"),
 )
 
 CATEGORIES = ("device_busy", "cold_plan", "qos_pause", "pager_gather",
-              "admission_retry", "prefill_chunk", "kv_demote",
+              "disagg", "admission_retry", "prefill_chunk", "kv_demote",
               "host_gap", "idle")
 
 

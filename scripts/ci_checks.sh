@@ -50,13 +50,19 @@
 #                   latency goodput >= 0.9x the no-fault baseline,
 #                   kill counted + evicted + on the chaos timeline
 #                   lane, zero zombie threads / stuck joins).
-#  10. flight smoke — CPU gate for the engine flight recorder
+#  10. disagg smoke — CPU gate for disaggregated prefill/decode
+#                   (scripts/smoke_disagg.py: prefill-role + decode-
+#                   role pair, transferred-prefix streams byte-
+#                   identical to colocated greedy, kv_transfer_pages
+#                   > 0, prefill-role never decodes, broken-transfer
+#                   fallback stays byte-identical and counted).
+#  11. flight smoke — CPU gate for the engine flight recorder
 #                   (scripts/smoke_flight.py: recorder on by default,
 #                   beat records >= decode_steps, recorder-on vs -off
 #                   token streams byte-identical, timeline JSON loads
 #                   and spans nest, analyzer attribution sums ~100%,
 #                   overhead <= 1% on paired bursts).
-#  11. tier-1 tests — the ROADMAP.md pytest gate.
+#  12. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -106,6 +112,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "chaos smoke (JAX_PLATFORMS=cpu scripts/smoke_chaos.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_chaos.py || fail=1
+
+    step "disagg smoke (JAX_PLATFORMS=cpu scripts/smoke_disagg.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_disagg.py || fail=1
 
     step "flight smoke (JAX_PLATFORMS=cpu scripts/smoke_flight.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_flight.py || fail=1

@@ -1,0 +1,158 @@
+"""Disagg smoke: the disaggregated prefill/decode contract, CPU-grade.
+
+A prefill-role + decode-role replica pair behind the router
+(fleet.disagg on) versus a colocated single engine. Gates:
+
+  (a) byte-identical streams: every greedy request served through the
+      two-stage plan (prefill on r0 -> KV page transfer -> decode on
+      r1) produces EXACTLY the single-engine token stream;
+  (b) pages actually moved: fleet kv_transfer_pages > 0, plans > 0,
+      and the decode replica's radix tree gained the transferred
+      prefix (its engine scores real prefix hits — zero re-prefill);
+  (c) role discipline: the prefill-role replica never serves decode
+      traffic (its engine generated exactly one stage token per
+      transferred plan, never a client stream);
+  (d) fallback: with the transfer path broken mid-fleet, the SAME
+      stream still completes byte-identically via colocated serving
+      and disagg_fallbacks counts it — disagg is an optimization,
+      never a correctness dependency.
+
+CI-grade: exits nonzero on any violation, prints one JSON summary.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/smoke_disagg.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+PS = 8
+
+
+def build_engine():
+    from generativeaiexamples_tpu.config.schema import EngineConfig
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.serving.engine import LLMEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = llama.LlamaConfig.tiny()
+    params = build_engine.params
+    if params is None:
+        params = build_engine.params = llama.init_params(
+            cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=256, page_size=PS,
+                        prefill_buckets=(16, 32), prefix_cache=True,
+                        pace_emission_max_streams=0, compile_cache_dir="")
+    return LLMEngine(params, cfg, ByteTokenizer(), ecfg, use_pallas=False)
+
+
+build_engine.params = None
+
+
+def collect(req, timeout=180):
+    toks = []
+    while True:
+        ev = req.stream.get(timeout=timeout)
+        if ev["token_id"] >= 0:
+            toks.append(ev["token_id"])
+        if ev["finished"]:
+            return toks, ev["finish_reason"]
+
+
+def run_one(target, prompt, max_new=16):
+    from generativeaiexamples_tpu.serving.engine import GenRequest
+
+    req = GenRequest(prompt_ids=list(prompt), max_new_tokens=max_new)
+    target.submit(req)
+    return collect(req)
+
+
+def main() -> int:
+    from generativeaiexamples_tpu.serving.fleet import (
+        EngineFleet, LocalReplica)
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    failures = []
+
+    def gate(name, ok, detail=""):
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}"
+              + (f" ({detail})" if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    prompts = [[(7 * i + j) % 250 + 1 for j in range(20 + 4 * i)]
+               for i in range(4)]
+
+    # Colocated single-engine reference.
+    single = build_engine().start()
+    want = [run_one(single, p) for p in prompts]
+    single.stop()
+
+    # (a)+(b)+(c): disagg pair.
+    reps = [LocalReplica("r0", build_engine(), role="prefill"),
+            LocalReplica("r1", build_engine(), role="decode")]
+    fleet = EngineFleet(reps, ByteTokenizer(), PS, disagg=True).start()
+    got = [run_one(fleet, p) for p in prompts]
+    snap = fleet.metrics.snapshot()
+    print("disagg smoke:")
+    gate("streams_byte_identical", got == want)
+    gate("kv_transfer_pages", snap["kv_transfer_pages"] > 0,
+         f"{snap['kv_transfer_pages']} pages, "
+         f"{snap['kv_transfer_ms']:.1f} ms")
+    gate("disagg_plans", snap["router_disagg_plans"] == len(prompts),
+         str(snap["router_disagg_plans"]))
+    gate("no_fallbacks", snap["disagg_fallbacks"] == 0)
+    gate("decode_tree_gained_prefix",
+         reps[1].engine.prefix_cache.n_cached_pages > 0
+         and reps[1].engine.metrics.prefix_hits == len(prompts),
+         f"{reps[1].engine.prefix_cache.n_cached_pages} pages, "
+         f"{reps[1].engine.metrics.prefix_hits} hits")
+    # The prefill engine ran one single-token stage per plan and no
+    # client decode stream (role discipline).
+    gate("prefill_role_never_decodes",
+         reps[0].engine.metrics.tokens_out
+         == snap["router_disagg_plans"],
+         f"{reps[0].engine.metrics.tokens_out} stage tokens")
+    transfer_pages = snap["kv_transfer_pages"]
+    transfer_ms = snap["kv_transfer_ms"]
+    fleet.stop()
+
+    # (d): break the transfer -> colocated fallback, same stream.
+    reps2 = [LocalReplica("r0", build_engine(), role="prefill"),
+             LocalReplica("r1", build_engine(), role="decode")]
+
+    def broken_import(ids, codes, scales, timeout_s=60.0):
+        raise RuntimeError("injected transfer fault")
+
+    reps2[1].import_kv_pages = broken_import
+    fleet2 = EngineFleet(reps2, ByteTokenizer(), PS, disagg=True).start()
+    got2 = [run_one(fleet2, p) for p in prompts]
+    snap2 = fleet2.metrics.snapshot()
+    gate("fallback_streams_byte_identical", got2 == want)
+    gate("fallback_counted",
+         snap2["disagg_fallbacks"] == len(prompts),
+         str(snap2["disagg_fallbacks"]))
+    gate("fallback_moved_no_pages", snap2["kv_transfer_pages"] == 0)
+    fleet2.stop()
+
+    print(json.dumps({
+        "disagg_smoke": "pass" if not failures else "fail",
+        "failures": failures,
+        "kv_transfer_pages": int(transfer_pages),
+        "kv_transfer_ms": round(float(transfer_ms), 1),
+        "transfer_ms_per_page": round(float(transfer_ms)
+                                      / max(1, transfer_pages), 2),
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,5 +324,108 @@ class TestSurfaces:
         assert all(r.state == "active" for r in fleet.replicas)
 
 
+def _hist_snap(samples_ms):
+    """Cumulative ExpHistogram snapshot over `samples_ms`."""
+    from generativeaiexamples_tpu.serving.flight import ExpHistogram
+
+    h = ExpHistogram()
+    for v in samples_ms:
+        h.observe(v)
+    return h.snapshot()
+
+
+class TestLatencyHistogramSignal:
+    """Satellite: hist_queue_wait_ms_latency / TTFT-p95 drift as a
+    second scale-up signal — per-poll DELTA, role-aware."""
+
+    def _make(self, hists, **kw):
+        """hists: mutable list of per-replica sample lists the hist_fn
+        re-renders every tick (cumulative, like the real engine)."""
+        def hist_fn():
+            return [(rid, role,
+                     {"queue_wait": _hist_snap(qw), "ttft": _hist_snap(tt)})
+                    for rid, role, qw, tt in hists]
+
+        kw.setdefault("cooldown_s", 0.0)
+        kw.setdefault("up_ticks", 1)
+        fleet, scaler, sig = make(hist_fn=hist_fn, **kw)
+        return fleet, scaler, sig
+
+    def test_queue_wait_delta_p95_scales_up(self):
+        hists = [["r0", "mixed", [], []]]
+        fleet, scaler, sig = self._make(
+            hists, up_queue_wait_p95_ms=100.0)
+        fleet.park("r1")  # a warm spare to wake
+        sig.total = 2.0  # depth alone is BELOW up_depth
+        # First tick records the baseline — old history never fires.
+        hists[0][2].extend([500.0] * 10)
+        assert scaler.tick(now=0.0) == "hold"
+        # No new samples: the delta is empty, signal quiet.
+        assert scaler.tick(now=1.0) == "hold"
+        # New slow samples in the window: delta p95 > threshold.
+        hists[0][2].extend([400.0] * 10)
+        assert scaler.tick(now=2.0) == "up"
+        assert fleet._by_rid["r1"].state == "active"
+        health = scaler.health()
+        assert health["latency_signal"]["last_delta_p95"][
+            "queue_wait"] > 100.0
+
+    def test_ttft_delta_p95_scales_up(self):
+        hists = [["r0", "mixed", [], []]]
+        fleet, scaler, sig = self._make(hists, up_ttft_p95_ms=200.0)
+        fleet.park("r1")
+        sig.total = 2.0
+        assert scaler.tick(now=0.0) == "hold"  # baseline
+        hists[0][3].extend([900.0] * 8)
+        assert scaler.tick(now=1.0) == "up"
+
+    def test_fast_window_stays_quiet(self):
+        hists = [["r0", "mixed", [], []]]
+        fleet, scaler, sig = self._make(
+            hists, up_queue_wait_p95_ms=100.0, up_ttft_p95_ms=100.0)
+        fleet.park("r1")
+        sig.total = 2.0
+        assert scaler.tick(now=0.0) == "hold"
+        hists[0][2].extend([5.0] * 50)  # plenty of FAST samples
+        hists[0][3].extend([8.0] * 50)
+        assert scaler.tick(now=1.0) == "hold"
+        assert fleet.metrics.snapshot()["autoscale_ups"] == 0
+
+    def test_signal_is_role_attributed(self):
+        """The hot role steers which spare wakes: a slow PREFILL pool
+        wakes the prefill-role spare even when a mixed spare sorts
+        first by rid."""
+        hists = [["r0", "prefill", [], []],
+                 ["r1", "decode", [], []]]
+        fleet, scaler, sig = self._make(
+            hists, n=4, up_queue_wait_p95_ms=100.0)
+        fleet.set_replica_role("r0", "prefill")
+        fleet.set_replica_role("r1", "decode")
+        fleet.set_replica_role("r3", "prefill")
+        fleet.park("r2")  # mixed spare (sorts first by rid)
+        fleet.park("r3")  # prefill spare
+        sig.total = 2.0
+        assert scaler.tick(now=0.0) == "hold"  # baseline
+        hists[0][2].extend([800.0] * 10)  # prefill pool is slow
+        assert scaler.tick(now=1.0) == "up"
+        assert fleet._by_rid["r3"].state == "active"  # the prefill one
+        assert fleet._by_rid["r2"].state != "active"
+        assert scaler.health()["hot_role"] == "prefill"
+
+    def test_scale_down_keeps_last_replica_of_each_role(self):
+        """Role-aware scale-down: an idle fleet with one prefill and
+        two decode replicas drains a DECODE one, never the only
+        prefill replica."""
+        fleet, scaler, sig = make(n=3, cooldown_s=0.0, down_ticks=1)
+        fleet.set_replica_role("r0", "prefill")
+        fleet.set_replica_role("r1", "decode")
+        fleet.set_replica_role("r2", "decode")
+        sig.total = 0.0
+        assert scaler.tick(now=0.0) == "down"
+        assert fleet._by_rid["r0"].state == "active"
+        assert sorted(fleet._by_rid[r].state for r in ("r1", "r2")) \
+            == ["active", "warm"]
+
+
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))

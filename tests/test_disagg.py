@@ -1,0 +1,372 @@
+"""Disaggregated prefill/decode: KV page transfer (serving/disagg.py).
+
+Covers the wire format (bit-identical round trips for f32 and
+int8+scales, through pickle AND a real socket boundary), the
+pool_to_pages -> bytes -> pages_to_pool cross-pool round trip, the
+engine export/import seams (a transferred prefix makes the target
+engine's streams byte-identical to a colocated engine), and the
+graftlint hot-path coverage of the transfer path (seeded violation).
+"""
+
+import os
+import pickle
+import socket
+import textwrap
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.config.schema import EngineConfig
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.serving import engine_model
+from generativeaiexamples_tpu.serving.disagg import (
+    KVPageTransfer, deserialize_kv_transfer, page_geometry,
+    serialize_kv_transfer)
+from generativeaiexamples_tpu.serving.engine import LLMEngine
+from generativeaiexamples_tpu.serving.kv_cache import PagePool
+from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+TINY = llama.LlamaConfig.tiny()
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(TINY, jax.random.PRNGKey(0))
+
+
+def make_engine(params, **over):
+    cfg = dict(max_batch_size=2, max_seq_len=256, page_size=PS,
+               prefill_buckets=(16, 32), prefix_cache=True,
+               pace_emission_max_streams=0, compile_cache_dir="")
+    cfg.update(over)
+    return LLMEngine(params, TINY, ByteTokenizer(), EngineConfig(**cfg),
+                     use_pallas=False)
+
+
+def _random_pool(dtype, n_pages=6):
+    rng = np.random.default_rng(7)
+    pool = PagePool.zeros(TINY, n_pages, PS, dtype=dtype)
+    if pool.quantized:
+        kv = rng.integers(-127, 128, pool.kv.shape, np.int8)
+        s = rng.random(pool.s.shape, np.float32)
+        return type(pool)(jnp.asarray(kv), jnp.asarray(s), PS)
+    k = rng.standard_normal(pool.k.shape).astype(pool.k.dtype)
+    v = rng.standard_normal(pool.v.shape).astype(pool.v.dtype)
+    return PagePool(jnp.asarray(k), jnp.asarray(v), PS)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+class TestWireFormat:
+    def _roundtrip(self, buf):
+        ids, codes, scales = deserialize_kv_transfer(buf)
+        return ids, codes, scales
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+    def test_serialize_roundtrip_bit_identical(self, dtype):
+        rng = np.random.default_rng(3)
+        cshape, cdtype, sshape = page_geometry(_random_pool(dtype))
+        n = 3
+        if cdtype == np.int8:
+            codes = rng.integers(-127, 128, (n,) + cshape, np.int8)
+        else:
+            codes = rng.standard_normal((n,) + cshape).astype(cdtype)
+        scales = (rng.random((n,) + sshape, np.float32)
+                  if sshape else None)
+        ids = list(range(n * PS))
+        buf = serialize_kv_transfer(ids, codes, scales)
+        got_ids, got_codes, got_scales = self._roundtrip(buf)
+        assert got_ids == ids
+        assert got_codes.dtype == codes.dtype
+        np.testing.assert_array_equal(got_codes, codes)
+        if scales is None:
+            assert got_scales is None
+        else:
+            np.testing.assert_array_equal(got_scales, scales)
+
+    def test_payload_survives_pickle_and_socket(self):
+        """The cross-process contract: the byte payload (pickled, then
+        pushed through a real socketpair) reconstructs bit-identical
+        arrays — no dtype/endianness/shape drift at a process
+        boundary."""
+        rng = np.random.default_rng(5)
+        cshape, cdtype, sshape = page_geometry(_random_pool("int8"))
+        codes = rng.integers(-127, 128, (2,) + cshape, np.int8)
+        scales = rng.random((2,) + sshape, np.float32)
+        buf = pickle.loads(pickle.dumps(
+            serialize_kv_transfer([1] * 2 * PS, codes, scales)))
+        a, b = socket.socketpair()
+        try:
+            def send():
+                a.sendall(buf)
+                a.shutdown(socket.SHUT_WR)
+
+            t = threading.Thread(target=send)
+            t.start()
+            chunks = []
+            while True:
+                c = b.recv(65536)
+                if not c:
+                    break
+                chunks.append(c)
+            t.join()
+        finally:
+            a.close()
+            b.close()
+        ids, got_codes, got_scales = deserialize_kv_transfer(
+            b"".join(chunks))
+        assert ids == [1] * 2 * PS
+        np.testing.assert_array_equal(got_codes, codes)
+        np.testing.assert_array_equal(got_scales, scales)
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError):
+            deserialize_kv_transfer(b"nope" + b"\x00" * 64)
+
+    def test_truncated_payload_raises_value_error(self):
+        """Garbled/truncated payloads must surface as ValueError (the
+        import endpoint's 422), whatever the underlying parse error
+        (struct.error on a cut header, short array bytes, ...)."""
+        cshape, cdtype, sshape = page_geometry(_random_pool("int8"))
+        codes = np.zeros((2,) + cshape, np.int8)
+        scales = np.zeros((2,) + sshape, np.float32)
+        full = serialize_kv_transfer([1] * 2 * PS, codes, scales)
+        for cut in (7, 12, len(full) // 2):
+            with pytest.raises(ValueError):
+                deserialize_kv_transfer(full[:cut])
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+    def test_pool_to_pages_bytes_pages_to_pool_roundtrip(self, dtype):
+        """The full transfer data path across two POOLS: gather pages
+        from a source pool, serialize, deserialize, scatter into a
+        zeroed target pool — the target's pages must be bit-identical
+        to the source's (codes AND int8 scales verbatim)."""
+        src = _random_pool(dtype)
+        dst = PagePool.zeros(TINY, 6, PS, dtype=dtype)
+        rows = [2, 4, 5]
+        row = jnp.asarray(np.array(rows, np.int32))
+        codes, scales = engine_model.pool_to_pages(src, row)
+        buf = serialize_kv_transfer(list(range(len(rows) * PS)),
+                                    np.asarray(codes),
+                                    None if scales is None
+                                    else np.asarray(scales))
+        _, got_codes, got_scales = deserialize_kv_transfer(buf)
+        dst = engine_model.pages_to_pool(
+            dst, jnp.asarray(got_codes),
+            None if got_scales is None else jnp.asarray(got_scales),
+            row)
+        if src.quantized:
+            np.testing.assert_array_equal(
+                np.asarray(dst.kv[:, :, :, rows]),
+                np.asarray(src.kv[:, :, :, rows]))
+            np.testing.assert_array_equal(
+                np.asarray(dst.s[:, :, :, rows]),
+                np.asarray(src.s[:, :, :, rows]))
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(dst.k[:, :, rows]),
+                np.asarray(src.k[:, :, rows]))
+            np.testing.assert_array_equal(
+                np.asarray(dst.v[:, :, rows]),
+                np.asarray(src.v[:, :, rows]))
+
+
+# ---------------------------------------------------------------------------
+# engine export / import seams
+# ---------------------------------------------------------------------------
+
+class TestEngineTransfer:
+    def _greedy(self, eng, prompt, max_new=12):
+        return [ev["token_id"] for ev in
+                eng.generate_stream(list(prompt), max_new_tokens=max_new)
+                if ev["token_id"] >= 0]
+
+    def test_export_import_transfers_prefix_and_streams_match(self,
+                                                              params):
+        """e1 prefills a prompt; its pages export, import into e2;
+        e2's greedy stream equals a colocated engine's, with e2's
+        admission scoring a real prefix hit (zero re-prefill of the
+        transferred prefix)."""
+        prompt = [(3 * j) % 250 + 1 for j in range(26)]  # 3 full pages
+        ref = make_engine(params).start()
+        want = self._greedy(ref, prompt)
+        ref.stop()
+
+        e1 = make_engine(params).start()
+        self._greedy(e1, prompt, max_new=1)  # prefill + cache insert
+        out = e1.run_control_op(lambda: e1.export_prefix_pages(prompt))
+        e1.stop()
+        assert out is not None
+        codes, scales, n_tokens = out
+        assert n_tokens == (len(prompt) // PS) * PS
+        assert codes.shape[0] == len(prompt) // PS
+
+        e2 = make_engine(params).start()
+        n = e2.run_control_op(
+            lambda: e2.import_prefix_pages(prompt, codes, scales))
+        assert n == codes.shape[0]
+        assert e2.prefix_cache.n_cached_pages == n
+        got = self._greedy(e2, prompt)
+        assert got == want
+        assert e2.metrics.prefix_hits == 1
+        snap = e2.metrics.snapshot()
+        assert snap["kv_transfer_pages"] == n
+        assert snap["kv_transfer_ms"] > 0
+        assert snap["hist_kv_transfer_ms_per_page"]["count"] == 1
+        e2.stop()
+
+    def test_import_ships_only_nonresident_suffix(self, params):
+        """A growing multi-turn prefix re-imports every turn; the
+        target must allocate/scatter only the chunks it does NOT
+        already hold (re-shipping a 1000-page conversation for a
+        one-page tail would reclaim-evict hot cache for nothing)."""
+        turn1 = [(3 * j) % 250 + 1 for j in range(2 * PS)]
+        turn2 = turn1 + [(5 * j) % 250 + 1 for j in range(2 * PS)]
+        e1 = make_engine(params).start()
+        e2 = make_engine(params).start()
+        try:
+            self._greedy(e1, turn2, max_new=1)  # caches all 4 pages
+            codes, scales, _ = e1.run_control_op(
+                lambda: e1.export_prefix_pages(turn2))
+            # Seed the target with turn 1's two pages only.
+            n1 = e2.run_control_op(
+                lambda: e2.import_prefix_pages(turn1, codes[:2],
+                                               None if scales is None
+                                               else scales[:2]))
+            assert n1 == 2
+            # Full-prefix import now moves ONLY the tail.
+            n2 = e2.run_control_op(
+                lambda: e2.import_prefix_pages(turn2, codes, scales))
+            assert n2 == 2
+            assert e2.metrics.kv_transfer_pages == 4
+            assert e2.prefix_cache.n_cached_pages == 4
+            # ...and the full path still serves byte-identically.
+            ref = make_engine(params).start()
+            want = self._greedy(ref, turn2)
+            ref.stop()
+            assert self._greedy(e2, turn2) == want
+        finally:
+            e1.stop()
+            e2.stop()
+
+    def test_import_already_resident_is_noop(self, params):
+        prompt = [(5 * j) % 250 + 1 for j in range(18)]  # 2 full pages
+        e1 = make_engine(params).start()
+        self._greedy(e1, prompt, max_new=1)
+        codes, scales, _ = e1.run_control_op(
+            lambda: e1.export_prefix_pages(prompt))
+        # Importing into the engine that already holds the prefix
+        # moves nothing (and allocates nothing it keeps).
+        n = e1.run_control_op(
+            lambda: e1.import_prefix_pages(prompt, codes, scales))
+        assert n == 0
+        assert e1.metrics.kv_transfer_pages == 0
+        e1.stop()
+
+    def test_export_nothing_cached_returns_none(self, params):
+        eng = make_engine(params)
+        assert eng.export_prefix_pages([1, 2, 3]) is None
+
+    def test_control_op_runs_inline_when_stopped(self, params):
+        eng = make_engine(params)
+        assert eng.run_control_op(lambda: 41 + 1) == 42
+
+    def test_control_op_propagates_errors(self, params):
+        eng = make_engine(params).start()
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                eng.run_control_op(
+                    lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        finally:
+            eng.stop()
+
+    def test_kvpagetransfer_moves_between_local_replicas(self, params):
+        from generativeaiexamples_tpu.serving.fleet import LocalReplica
+
+        prompt = [(7 * j) % 250 + 1 for j in range(20)]
+        e1, e2 = make_engine(params).start(), make_engine(params).start()
+        try:
+            self._greedy(e1, prompt, max_new=1)
+            pages, ms = KVPageTransfer().transfer(
+                LocalReplica("a", e1), LocalReplica("b", e2), prompt)
+            assert pages == len(prompt) // PS
+            assert ms > 0
+            assert e2.prefix_cache.n_cached_pages == pages
+        finally:
+            e1.stop()
+            e2.stop()
+
+
+# ---------------------------------------------------------------------------
+# graftlint hot-path coverage of the transfer path
+# ---------------------------------------------------------------------------
+
+class TestLintCoverage:
+    def test_hot_path_markers_cover_transfer_path(self, tmp_path):
+        """The transfer/placement path carries `# graftlint: hot-path`
+        markers, so GL401 covers it: a seeded blocking host sync
+        inside a marked transfer method is flagged, and the shipped
+        module itself stays clean."""
+        from generativeaiexamples_tpu.lint import lint_paths
+
+        src_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "generativeaiexamples_tpu",
+            "serving", "disagg.py")
+        with open(src_path) as fh:
+            src = fh.read()
+        bad = src + textwrap.dedent("""
+
+        class _SeededBadTransfer(KVPageTransfer):
+            # graftlint: hot-path
+            def hack(self):
+                return np.asarray(self.dev_staging)  # blocking sync
+        """)
+        mod = tmp_path / "disagg.py"
+        mod.write_text(bad)
+        findings = [f for f in lint_paths([str(mod)])
+                    if f.check == "GL401"]
+        assert any("dev_staging" in f.message or "asarray" in f.message
+                   for f in findings)
+        # ...and the shipped transfer module is clean.
+        assert not [f for f in lint_paths([src_path])
+                    if f.check in ("GL401", "GL402")]
+
+    def test_place_disagg_and_fleet_transfer_are_declared_hot(self):
+        """The satellite contract: the placement + transfer entry
+        points are DECLARED hot (HOT_ROOTS or an explicit marker), so
+        the interprocedural host-sync checks scan them."""
+        import ast
+
+        from generativeaiexamples_tpu.lint.checks.host_sync import (
+            declared_hot)
+        from generativeaiexamples_tpu.lint.core import SourceFile
+
+        base = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "generativeaiexamples_tpu",
+            "serving")
+        want = {"router.py": {"place_disagg"},
+                "fleet.py": {"_submit_disagg", "_run_disagg_stages",
+                             "export_kv_pages", "import_kv_pages"},
+                "disagg.py": {"transfer"}}
+        for fname, fns in want.items():
+            path = os.path.join(base, fname)
+            with open(path) as fh:
+                source = fh.read()
+            tree = ast.parse(source)
+            sf = SourceFile(path, rel=fname, source=source, tree=tree,
+                            lines=source.splitlines())
+            found = {}
+            for node in ast.walk(tree):
+                if isinstance(node, ast.FunctionDef):
+                    found[node.name] = node
+            for fn in fns:
+                if fn not in found:
+                    continue  # e.g. _submit_disagg folded elsewhere
+                assert declared_hot(sf, found[fn]), \
+                    f"{fname}:{fn} lost its hot-path marker"

@@ -603,6 +603,190 @@ class TestFleetE2E:
 
 
 # ---------------------------------------------------------------------------
+# disaggregated prefill/decode (serving/disagg.py)
+# ---------------------------------------------------------------------------
+
+class TestDisaggPlacement:
+    """Two-stage placement units (no engines)."""
+
+    def _router(self, roles=("prefill", "decode"), **kw):
+        r = PrefixLocalityRouter(PS, **kw)
+        for i, role in enumerate(roles):
+            r.add_replica(f"r{i}", self_feed=True, role=role)
+        return r
+
+    def test_prefill_role_never_receives_decode_placement(self):
+        r = self._router(("prefill", "decode", "mixed"))
+        for i in range(16):
+            rid = r.place([i] * 24, session=f"s{i}")
+            assert r.roles()[rid] != "prefill"
+        # With ONLY prefill-role replicas admitting, decode placement
+        # has nowhere to go — 503, not a silent prefill-side decode.
+        lone = self._router(("prefill",))
+        with pytest.raises(LookupError):
+            lone.place([1] * 24)
+
+    def test_place_disagg_emits_two_stage_plan(self):
+        r = self._router(("prefill", "decode"))
+        plan = r.place_disagg([7] * 24)
+        assert plan == ("r0", "r1")
+        assert r.snapshot()["router_disagg_plans"] == 1
+        # One placement's worth of bookkeeping, not two.
+        assert r.snapshot()["router_requests"] == 1
+
+    def test_place_disagg_colocated_when_decode_holds_prefix(self):
+        """A decode replica already shadowing the full-page prefix
+        serves colocated — the transfer would move bytes it has. The
+        shadow-coverage check must read the PRE-placement state (a
+        self-feeding shadow absorbs the prompt during placement)."""
+        r = self._router(("prefill", "decode"))
+        prompt = [5] * 24
+        plan = r.place_disagg(prompt)
+        assert plan == ("r0", "r1")  # first sight: transfer
+        plan2 = r.place_disagg(prompt)
+        assert plan2 == ("", "r1")   # replay: the prefix is there
+        assert r.snapshot()["router_disagg_plans"] == 1
+
+    def test_place_disagg_none_without_prefill_role(self):
+        r = self._router(("decode", "mixed"))
+        assert r.place_disagg([3] * 24) is None
+
+    def test_place_disagg_subpage_prompt_is_colocated(self):
+        r = self._router(("prefill", "decode"))
+        prid, drid = r.place_disagg([1] * (PS - 1))
+        assert prid == "" and drid == "r1"
+        assert r.snapshot()["router_disagg_plans"] == 0
+
+
+class TestDisaggE2E:
+    def _pair(self, params, **fleet_kw):
+        reps = [LocalReplica("r0", make_engine(params), role="prefill"),
+                LocalReplica("r1", make_engine(params), role="decode")]
+        fleet = EngineFleet(reps, ByteTokenizer(), PS, disagg=True,
+                            **fleet_kw).start()
+        return fleet, reps
+
+    def test_two_stage_streams_byte_identical(self, params):
+        """The acceptance gate: disagg streams equal colocated greedy,
+        pages move, and the decode replica's radix tree gains the
+        transferred prefix (its engine scores a real prefix hit)."""
+        prompts = [[(7 * i + j) % 250 + 1 for j in range(20 + 4 * i)]
+                   for i in range(3)]
+        single = make_engine(params).start()
+        want = [run_one(single, p) for p in prompts]
+        single.stop()
+        fleet, reps = self._pair(params)
+        try:
+            got = [run_one(fleet, p) for p in prompts]
+            assert got == want
+            snap = fleet.metrics.snapshot()
+            assert snap["kv_transfer_pages"] > 0
+            assert snap["kv_transfer_ms"] > 0
+            assert snap["router_disagg_plans"] == len(prompts)
+            assert snap["disagg_requests"] == len(prompts)
+            assert snap["disagg_fallbacks"] == 0
+            # Decode tree gained each transferred prefix -> hit path.
+            assert reps[1].engine.prefix_cache.n_cached_pages > 0
+            assert reps[1].engine.metrics.prefix_hits == len(prompts)
+            # Prefill role never decoded a client stream: exactly one
+            # stage token per plan.
+            assert reps[0].engine.metrics.tokens_out == len(prompts)
+            health = fleet.fleet_health()
+            assert health["disagg"]["enabled"] is True
+            assert health["disagg"]["plans"] == len(prompts)
+            assert health["replicas"]["r0"]["role"] == "prefill"
+        finally:
+            fleet.stop()
+
+    def test_transfer_failure_falls_back_colocated_same_stream(
+            self, params):
+        prompt = [9] * 24
+        single = make_engine(params).start()
+        want = run_one(single, prompt)
+        single.stop()
+        fleet, reps = self._pair(params)
+
+        def broken(ids, codes, scales, timeout_s=60.0):
+            raise RuntimeError("injected transfer fault")
+
+        reps[1].import_kv_pages = broken
+        try:
+            assert run_one(fleet, prompt) == want
+            snap = fleet.metrics.snapshot()
+            assert snap["disagg_fallbacks"] == 1
+            assert snap["kv_transfer_pages"] == 0
+        finally:
+            fleet.stop()
+
+    def test_prefill_stage_bails_fast_when_replica_evicted(self):
+        """The internal prefill stage carries no _ReqRecord, so an
+        eviction delivers it no terminal event — the wait loop must
+        notice the replica state and fall back NOW, not after the
+        full disagg_prefill_timeout_s."""
+        fakes = [FakeReplica("r0"), FakeReplica("r1")]
+        fakes[0].role, fakes[1].role = "prefill", "decode"
+        fleet = EngineFleet(fakes, ByteTokenizer(), PS, disagg=True,
+                            disagg_prefill_timeout_s=30.0).start()
+        try:
+            req = GenRequest(prompt_ids=[3] * 24, max_new_tokens=4)
+
+            def evict_soon():
+                time.sleep(0.3)
+                with fleet._lock:
+                    fakes[0].state = "evicted"
+
+            threading.Thread(target=evict_soon).start()
+            t0 = time.monotonic()
+            fleet.submit(req)  # fake replicas emit nothing; the stage
+            elapsed = time.monotonic() - t0
+            assert elapsed < 10.0, f"stage spun {elapsed:.1f}s"
+            assert fleet.metrics.snapshot()["disagg_fallbacks"] == 1
+            # The client request itself still dispatched (to r1).
+            assert req in fakes[1].submitted
+        finally:
+            fleet.stop()
+
+    def test_decode_load_reserved_during_stage_window(self):
+        """Concurrent disagg placements must see the planned decode
+        replica's load DURING the prefill/transfer window, not only
+        after the decode dispatch."""
+        fakes = [FakeReplica("r0"), FakeReplica("r1")]
+        fakes[0].role, fakes[1].role = "prefill", "decode"
+        fleet = EngineFleet(fakes, ByteTokenizer(), PS,
+                            disagg=True).start()
+        try:
+            seen = {}
+
+            def spy(prid, drid, req):
+                seen["depth"] = fleet.router.queue_depths()[drid]
+                return False
+
+            fleet._run_disagg_stages = spy
+            fleet.submit(GenRequest(prompt_ids=[6] * 24,
+                                    max_new_tokens=4))
+            assert seen["depth"] == 1  # the reservation, mid-stage
+            # ...and it was released: depth now reflects only the
+            # real dispatch's tracking record.
+            assert fleet.router.queue_depths()["r1"] == 1
+        finally:
+            fleet.stop()
+
+    def test_min_prompt_tokens_keeps_shorts_on_decode_pool(self, params):
+        fleet, reps = self._pair(params, disagg_min_prompt_tokens=64)
+        try:
+            assert run_one(fleet, [4] * 24)  # short: below the bar
+            snap = fleet.metrics.snapshot()
+            assert snap["router_disagg_plans"] == 0
+            assert snap["disagg_requests"] == 0
+            # ...and it served on the decode replica, not the prefill
+            # one (role discipline holds for colocated shorts too).
+            assert reps[0].engine.metrics.tokens_out == 0
+            assert reps[1].engine.metrics.tokens_out > 0
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
 # surfaces
 # ---------------------------------------------------------------------------
 
@@ -613,7 +797,9 @@ class TestCounterSurfaces:
         for key in ("router_requests", "router_prefix_hits",
                     "router_hit_tokens", "router_affinity_hits",
                     "router_rebalances", "replica_evictions",
-                    "router_requeued"):
+                    "router_requeued", "router_disagg_plans",
+                    "kv_transfer_pages", "kv_transfer_ms",
+                    "disagg_requests", "disagg_fallbacks"):
             assert snap[key] == 0
         assert snap["router_queue_depth"] == {}
 
