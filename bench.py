@@ -19,7 +19,10 @@ lane-exclusive r05 config), BENCH_REPEAT (headline burst repetitions,
 default 3; median reported — same as the --repeat N flag),
 BENCH_K, BENCH_PIPELINE, BENCH_DEVICE_INIT, BENCH_LONGCTX (0 skips),
 BENCH_FUSED (0 skips),
-BENCH_PREFIX (0 skips), BENCH_ENCODERS (0 skips), BENCH_ANN (0 skips;
+BENCH_PREFIX (0 skips), BENCH_ENCODERS (0 skips), BENCH_KERNELS
+(0 skips; BENCH_KERNELS_ITERS and the BENCH_PEAK_* overrides tune the
+kernel roofline microbench, scripts/bench_kernels.py),
+BENCH_ANN (0 skips;
 BENCH_ANN_N / _DIM / _NLIST / _NPROBE tune the corpus and index),
 BENCH_ANN_TIERED (0 skips; BENCH_ANN_TIERED_N / _DIM / _NLIST /
 _NPROBE / _HBM_MB / _WRITE_ROWS tune the capacity corpus, the forced
@@ -55,9 +58,10 @@ r05 added it mid-flight; it is now a constant, identical in all runs).
 The r05 official config is BENCH_SPEC=1 BENCH_TREE=0 BENCH_PLANS=0;
 the default now enables step plans + fused_prefill + tree drafts
 (k=3, 4 branches) — the composed lattice whose ceiling the tree
-verify raises. Note the tree path rides the XLA gather attention (no
-Pallas tree kernel yet), so compare both configs when reading
-hardware numbers.
+verify raises. On TPU the tree path now dispatches the Pallas
+tree-attention kernels (bf16 + int8 twins,
+serving/paged_attention_tree.py; ENGINE_TREE_KERNEL=0 reverts to the
+XLA gather route for A/B reads).
 
 Scenario output keys (under "extras"):
   long-context:  ttft_prompt2k_ms, ttft_prompt8k_ms,
@@ -90,6 +94,20 @@ Scenario output keys (under "extras"):
                  a standalone pager microbench. BENCH_KV_TIER=0 skips)
   encoders:      embed_docs_per_sec, embed_queries_per_sec,
                  rerank_pairs_per_sec
+  kernel roofline: kern_<kernel>_ms, kern_<kernel>_gb_s,
+                 kern_<kernel>_gflop_s, kern_<kernel>_hbm_util,
+                 kern_<kernel>_mxu_util for kernels paged_bf16,
+                 paged_int8, tree_bf16, tree_int8, tree_xla_ref,
+                 int8_matmul, flash_prefill, plus kern_backend,
+                 kern_device_kind and the kern_peak_* denominators
+                 (per-kernel achieved vs peak bytes/s and FLOP/s from
+                 scripts/bench_kernels.py — decode-attention kernels
+                 are HBM-bound, so kern_*_hbm_util is their headline;
+                 tree_xla_ref times the gather route the tree kernels
+                 replace at the same shape. int8/tree entries are
+                 TPU-only; BENCH_KERNELS=0 skips.
+                 `bench_kernels.py --verify` is the kernel-parity
+                 entry point, gated on CPU by smoke_kernels.py)
   ANN retrieval: ann_search_qps, ann_vs_flat_speedup, ann_recall_at_4,
                  ann_batch_qps, ann_int8_qps, ann_scanned_rows_per_query,
                  flat_search_qps (IVF vs exact brute-force MIPS through
@@ -617,6 +635,31 @@ def main() -> None:
         except Exception as e:  # report, don't kill the headline metric
             encoder_stats = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- kernel roofline microbench (ISSUE 15 tentpole): per-kernel
+    # achieved vs peak bytes/s and FLOP/s for the paged linear/tree
+    # attention kernels (bf16 + int8), the int8 matmul and flash
+    # prefill — scripts/bench_kernels.py, run in-process on the same
+    # accelerator AFTER the engines are torn down (the pools it
+    # allocates need the HBM to itself). kern_* keys make kernel
+    # regressions visible per-PR without decoding the e2e headline.
+    kernel_stats = {}
+    if os.environ.get("BENCH_KERNELS", "1") != "0":
+        import gc
+
+        # Guard like every sibling scenario: when earlier blocks were
+        # skipped via env knobs, the headline engine pool and the 8b
+        # weights are still resident — the roofline pools (B=128,
+        # P=513 at the TPU geometry) must not allocate on top of them.
+        eng = None
+        params = None
+        gc.collect()
+        try:
+            from scripts.bench_kernels import run_bench as _kern_run
+
+            kernel_stats = _kern_run()
+        except Exception as e:
+            kernel_stats = {"kernel_error": f"{type(e).__name__}: {e}"}
+
     # -- ANN retrieval: IVF vs flat brute-force MIPS at 100k vectors
     # (ISSUE 2 tentpole — per-query retrieval cost must stop scaling
     # linearly with corpus size).
@@ -749,6 +792,7 @@ def main() -> None:
             **prefix_stats,
             **kv_tier_stats,
             **encoder_stats,
+            **kernel_stats,
             **ann_stats,
             **tiered_stats,
             **concurrent_stats,

@@ -301,6 +301,24 @@ class EngineConfig:
     # it). The rider's chunk width is the largest power of two <=
     # min(budget, largest prefill bucket).
     fused_token_budget: int = 512
+    # Fused first-token sampling: the chunk that COMPLETES a prompt
+    # (chunked long prefills, prefix-cache-hit suffixes) samples its
+    # first token and scatters it into the device token buffer INSIDE
+    # the same dispatch (engine_model.prefill_chunk_sample_step), and
+    # every other finish folds sample_token + set_last_token into one
+    # program (sample_token_into) — the beat gap between a finished
+    # prefill and its first decode block loses 1-2 host-side
+    # dispatches. Decode-block sampling is always fused (it has lived
+    # inside decode_multi_step since PR 4); this knob covers the
+    # finish tails. On by default: the fused tail computes exactly the
+    # unfused math with the same key stream — greedy streams bitwise-
+    # identical and sampled draws key-identical on CPU CI (tests pin
+    # both; on TPU the fused and unfused variants are distinct XLA
+    # programs, so an argmax near-tie could in principle round
+    # differently — the same program-identity caveat the fused
+    # prefill rider carries). Off restores the two-dispatch finish
+    # for A/B measurement.
+    fused_sampling: bool = True
     # Cross-request prefix KV reuse (the RadixAttention / vLLM-APC /
     # NIM KV-reuse role, serving/prefix_cache.py): a host-side radix
     # tree maps page-granular prompt prefixes to ref-counted pool

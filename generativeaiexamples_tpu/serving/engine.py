@@ -303,6 +303,13 @@ class EngineMetrics:
         self.fused_steps = 0
         self.fused_prefill_tokens = 0
         self.prefill_stall_beats = 0
+        # Fused first-token sampling (engine.fused_sampling): prompt
+        # finishes whose sample + last_tokens scatter rode a single
+        # dispatch — the prompt-completing chunk's in-program tail
+        # (prefill_chunk_sample_step) or the merged sample_token_into
+        # finish. Always present — 0, never absent, when the knob is
+        # off.
+        self.fused_sample_dispatches = 0
         # Prefix-cache counters (serving/prefix_cache.py): lookups that
         # adopted cached pages / that found nothing, pages LRU-evicted,
         # and prompt tokens whose prefill was skipped via the cache.
@@ -408,6 +415,7 @@ class EngineMetrics:
             "fused_steps": self.fused_steps,
             "fused_prefill_tokens": self.fused_prefill_tokens,
             "prefill_stall_beats": self.prefill_stall_beats,
+            "fused_sample_dispatches": self.fused_sample_dispatches,
             "prefix_hits": self.prefix_hits,
             "prefix_miss": self.prefix_miss,
             "prefix_evictions": self.prefix_evictions,
@@ -753,6 +761,16 @@ class LLMEngine:
         # interleaved lane — the tail chunk buckets to the smallest
         # warmed power-of-two width instead of padding to full chunk.
         self._warm_chunk_widths: set = set()
+        # Fused first-token sampling (engine.fused_sampling): the
+        # prompt-completing chunk samples + scatters its first token
+        # inside the same dispatch (prefill_chunk_sample_step), and
+        # other finishes merge sample_token + set_last_token into one
+        # program. _warm_sample_chunks mirrors _warm_chunk_widths for
+        # the sample-tail variant — a warmed engine never compiles it
+        # mid-traffic; unwarmed (CPU tests) compiles on demand.
+        self._fused_sampling = bool(getattr(self.ecfg, "fused_sampling",
+                                            True))
+        self._warm_sample_chunks: set = set()
         # Reusable host staging buffers for chunk dispatches, keyed by
         # width (one np array per width for the engine's lifetime —
         # the old path allocated a fresh (1, chunk) buffer per chunk).
@@ -1008,6 +1026,8 @@ class LLMEngine:
                     self._put(np.int32(1)), self.use_pallas,
                     mesh=self.mesh)
                 self._warm_chunk_widths.add((s_tot, chunk))
+                cache = self._warm_sample_chunk(s_tot, chunk, cache,
+                                                flag_sets, key)
                 for w in sorted(tail_widths[s_tot]):
                     logits, cache = engine_model.prefill_chunk_step(
                         self.params, self.cfg, cache,
@@ -1015,6 +1035,8 @@ class LLMEngine:
                         self._put(np.int32(1)), self.use_pallas,
                         mesh=self.mesh)
                     self._warm_chunk_widths.add((s_tot, w))
+                    cache = self._warm_sample_chunk(s_tot, w, cache,
+                                                    flag_sets, key)
                 self.pool = engine_model.cache_to_pool(
                     self.pool, cache, self.cfg,
                     self._put(np.zeros((s_tot // ps,), np.int32)))
@@ -1093,6 +1115,7 @@ class LLMEngine:
                         logits, 0.0, 1.0, 0, key, *flags)
                 self._last_tokens = engine_model.set_last_token(
                     self._last_tokens, self._put(np.int32(0)), tok0)
+                self._warm_sample_into(logits, flag_sets, key)
         if self.prefix_cache is not None:
             # Prefix-cache hit variants for SHORT prompts: a hit
             # gathers into a bucket-sized scratch (pool_to_cache per
@@ -1119,6 +1142,8 @@ class LLMEngine:
                         self._put(np.int32(1)), self.use_pallas,
                         mesh=self.mesh)
                     self._warm_chunk_widths.add((s_tot, chunk))
+                    cache = self._warm_sample_chunk(s_tot, chunk, cache,
+                                                    flag_sets, key)
                 self.pool = engine_model.cache_to_pool(
                     self.pool, cache, self.cfg,
                     self._put(np.zeros((s_tot // ps,), np.int32)))
@@ -1128,6 +1153,7 @@ class LLMEngine:
                                                  key, *flags)
             self._last_tokens = engine_model.set_last_token(
                 self._last_tokens, self._put(np.int32(0)), tok0)
+            self._warm_sample_into(logits, flag_sets, key)
             if self._spec_k:
                 # Hit finishes write history through the full-width
                 # single-row variant (long_prompts warmup only covers
@@ -1176,6 +1202,42 @@ class LLMEngine:
                   * len(group_sizes) * len(flag_sets),
                   len(ks) * len(flag_sets))
         return self
+
+    def _warm_sample_into(self, logits, flag_sets, key) -> None:
+        """Compile the merged sample_token_into finish
+        (engine.fused_sampling) against warmup logits for every
+        sampling-flag set — shared by the long-prompts and
+        prefix-cache warmup finishes so the two sites can't drift."""
+        if not self._fused_sampling:
+            return
+        for flags in flag_sets:
+            _, self._last_tokens = engine_model.sample_token_into(
+                self._last_tokens, self._put(np.int32(0)), logits,
+                0.0, 1.0, 0, key, *flags)
+
+    def _warm_sample_chunk(self, s_tot: int, width: int, cache,
+                           flag_sets, key):
+        """Compile the fused first-token tail for one chunk shape
+        (engine.fused_sampling): prefill_chunk_sample_step per
+        sampling-flag set, registered in _warm_sample_chunks so the
+        prompt-completing chunk may dispatch it without a mid-traffic
+        compile. Chains and returns the donated scratch cache; the
+        dummy slot index / sampling params mirror the neighboring
+        warmup calls (garbage state, page-0 sink)."""
+        if not self._fused_sampling:
+            return cache
+        for flags in flag_sets:
+            _, self._last_tokens, cache = \
+                engine_model.prefill_chunk_sample_step(
+                    self.params, self.cfg, cache,
+                    self._put(np.zeros((1, width), np.int32)),
+                    self._put(np.int32(1)), self._last_tokens,
+                    self._put(np.int32(0)), 0.0, 1.0, 0, key,
+                    self.use_pallas, sampling_flags=flags, mesh=self.mesh)
+        self._warm_sample_chunks.add((s_tot, width))
+        self._warm_plans.add(engine_model.StepPlan(
+            rider_width=width, rider_s_total=s_tot, rider_sample=True))
+        return cache
 
     def start(self) -> "LLMEngine":
         self._running = True
@@ -2365,17 +2427,44 @@ class LLMEngine:
                                                    s_total)
                     tok = self._chunk_buf(width)
                     tok[0, :len(part)] = part
+                    final = lp.pos + len(part) >= len(lp.ids)
+                    # The prompt-completing chunk samples + scatters
+                    # its first token INSIDE the dispatch when the
+                    # fused-sampling tail is warmed for this shape
+                    # (engine.fused_sampling; never a cold compile on
+                    # a warmed engine).
+                    fuse_sample = (final and self._fused_sampling
+                                   and (not self._warm_ks
+                                        or (s_total, width)
+                                        in self._warm_sample_chunks))
                     # A rider-only plan (decode_k=0): the idle/fallback
                     # lane's chunk dispatch goes through the same
                     # plan_step entry point as every other device step.
+                    kw = dict(cache=lp.cache, chunk_tokens=self._put(tok),
+                              chunk_valid=self._put(np.int32(len(part))),
+                              use_pallas=self.use_pallas, mesh=self.mesh)
+                    if fuse_sample:
+                        req = lp.req
+                        greedy = req.temperature <= 0.0
+                        flags = (True, False, False) if greedy \
+                            else (False, True, True)
+                        kw.update(
+                            last_tokens=self._last_tokens,
+                            slot_idx=self._put(np.int32(lp.slot_idx)),
+                            temperature=req.temperature, top_p=req.top_p,
+                            top_k=req.top_k, rng=self._next_key(),
+                            sampling_flags=flags)
                     res = engine_model.plan_step(
                         self.params, self.cfg,
                         engine_model.StepPlan(rider_width=width,
-                                              rider_s_total=s_total),
-                        cache=lp.cache, chunk_tokens=self._put(tok),
-                        chunk_valid=self._put(np.int32(len(part))),
-                        use_pallas=self.use_pallas, mesh=self.mesh)
-                    logits, lp.cache = res["chunk_logits"], res["cache"]
+                                              rider_s_total=s_total,
+                                              rider_sample=fuse_sample),
+                        **kw)
+                    lp.cache = res["cache"]
+                    logits = res.get("chunk_logits")
+                    if fuse_sample:
+                        self._last_tokens = res["last_tokens"]
+                        self.metrics.fused_sample_dispatches += 1
                     lp.pos += len(part)
                     self.metrics.prefill_tokens += len(part)
                     if self.flight.enabled:
@@ -2385,7 +2474,8 @@ class LLMEngine:
                             tier=tier_id(lp.tier), a=float(len(part)))
                     if lp.pos >= len(lp.ids):
                         self._long_prefills.remove(lp)
-                        self._finish_long_prefill(lp, logits)
+                        self._finish_long_prefill(lp, logits,
+                                                  tok0=res.get("tok0"))
                         break
             except Exception:
                 _LOG.exception("chunked prefill failed")
@@ -2469,9 +2559,20 @@ class LLMEngine:
                 self.metrics.prefill_stall_beats += 1
             lp.stall_pos = lp.pos
 
-    def _finish_long_prefill(self, lp: "_LongPrefill", logits) -> None:
+    def _finish_long_prefill(self, lp: "_LongPrefill", logits,
+                             tok0=None) -> None:
         """Last chunk fed: scatter the scratch cache into the page pool,
-        sample the first token on device, and open the slot for decode."""
+        sample the first token on device, and open the slot for decode.
+
+        tok0 is non-None when the finishing chunk rode the fused-
+        sampling tail (rider_sample plan): the sample + last_tokens
+        scatter already happened inside that dispatch, so only the
+        host-side bookkeeping remains here. Otherwise the first token
+        is sampled now — in ONE merged dispatch (sample_token_into)
+        under engine.fused_sampling, or the legacy sample_token +
+        set_last_token pair with the knob off (same math and key
+        stream either way — CPU CI pins byte-identical streams; the
+        knob only changes dispatch count)."""
         from generativeaiexamples_tpu.obs.tracing import ManualSpan
 
         ps = self.pool.page_size
@@ -2490,11 +2591,20 @@ class LLMEngine:
         req = lp.req
         greedy = req.temperature <= 0.0
         flags = (True, False, False) if greedy else (False, True, True)
-        tok0 = engine_model.sample_token(
-            logits, req.temperature, req.top_p, req.top_k,
-            self._next_key(), *flags)
-        self._last_tokens = engine_model.set_last_token(
-            self._last_tokens, self._put(np.int32(lp.slot_idx)), tok0)
+        if tok0 is None:
+            if self._fused_sampling:
+                tok0, self._last_tokens = engine_model.sample_token_into(
+                    self._last_tokens, self._put(np.int32(lp.slot_idx)),
+                    logits, req.temperature, req.top_p, req.top_k,
+                    self._next_key(), *flags)
+                self.metrics.fused_sample_dispatches += 1
+            else:
+                tok0 = engine_model.sample_token(
+                    logits, req.temperature, req.top_p, req.top_k,
+                    self._next_key(), *flags)
+                self._last_tokens = engine_model.set_last_token(
+                    self._last_tokens, self._put(np.int32(lp.slot_idx)),
+                    tok0)
         span = ManualSpan("engine.generate", context=req.trace_context,
                           attributes={"prompt_tokens": len(lp.ids),
                                       "chunked_prefill": True,

@@ -636,25 +636,31 @@ def _tree_verify_once(params, cfg: LlamaConfig, pool: PagePool,
                       page_tables: jax.Array,  # [B, maxp]
                       lengths: jax.Array,      # [B] incl. t0 (root)
                       depth, anc_mask,         # static layout (_tree_layout)
+                      spec_k: int, n_branches: int,  # static tree shape
                       use_pallas, mesh=None):
     """One tree-verify forward over r packed tree positions per
     sequence: node j's k/v is written (write-then-attend) at pool slot
     lengths-1+j with its ROPE position taken from its tree DEPTH
     (lengths-1+depth[j]); attention runs the packed tree-attention
-    mask (prefix + ancestor chain) over the gathered pages. Rejected
+    mask (prefix + ancestor chain) over the sequence's pages. Rejected
     nodes need no cleanup: the committed path is RELOCATED to the
     packed slots lengths-1 .. lengths-1+acc by _tree_relocate_commit,
     and everything past the new length is overwritten before it is
     ever attended (same contract as the linear verify path). Returns
     (logits [B, r, V], pool).
 
-    The tree mask is inexpressible with length-only masking, so this
-    path always takes the gather-based XLA attention route
-    (paged_tree_attention_reference) — a Pallas tree kernel is future
-    work; linear verify (n_branches <= 1) keeps its fused kernel."""
-    from generativeaiexamples_tpu.serving.paged_attention import (
-        paged_tree_attention_int8_reference_fused,
-        paged_tree_attention_reference)
+    Attention dispatch (serving/paged_attention_tree.py): on a
+    single-device TPU the packed ancestor mask is applied INSIDE the
+    Pallas paged flash-block loop — the bf16 tree kernel or the int8
+    fused-pool kernel with q_rep=r and the tree mask folded in, so
+    tree verify streams KV with linear decode's double-buffered
+    multi-page strategy. Elsewhere (CPU, tensor-parallel meshes, odd
+    geometries, ENGINE_TREE_KERNEL=0) the gather-based XLA references
+    in paged_attention.py remain the oracle route, and
+    ENGINE_TREE_KERNEL_INTERPRET=1 pins the kernels against them in
+    interpret mode on CPU CI."""
+    from generativeaiexamples_tpu.serving.paged_attention_tree import (
+        paged_tree_attention_dispatch, paged_tree_attention_int8_dispatch)
 
     B, r = tokens.shape
     ps = pool.page_size
@@ -692,9 +698,9 @@ def _tree_verify_once(params, cfg: LlamaConfig, pool: PagePool,
                 0, l, kh_idx, page_idx[None], offset[None]].set(ksc)
             s_pool = s_pool.at[
                 1, l, kh_idx, page_idx[None], offset[None]].set(vsc)
-            out = paged_tree_attention_int8_reference_fused(
-                q, kv_pool[:, l], s_pool[:, l], page_tables, lengths,
-                anc_mask)
+            out = paged_tree_attention_int8_dispatch(
+                q, kv_pool, s_pool, page_tables, lengths, anc_mask,
+                spec_k, n_branches, l, use_pallas=use_pallas, mesh=mesh)
             new_pools = (kv_pool, s_pool)
         else:
             k_pool, v_pool = pools
@@ -704,8 +710,9 @@ def _tree_verify_once(params, cfg: LlamaConfig, pool: PagePool,
             v_pool = v_pool.at[
                 l, kh_idx, page_idx[None], offset[None], :].set(
                 v_new.astype(v_pool.dtype))
-            out = paged_tree_attention_reference(
-                q, k_pool[l], v_pool[l], page_tables, lengths, anc_mask)
+            out = paged_tree_attention_dispatch(
+                q, k_pool[l], v_pool[l], page_tables, lengths, anc_mask,
+                spec_k, n_branches, use_pallas=use_pallas, mesh=mesh)
             new_pools = (k_pool, v_pool)
         x = _finish_block(cfg, x, out, w)              # out [B, H, r, Hd]
         return x, new_pools
@@ -814,7 +821,7 @@ def _spec_verify_loop(params, cfg: LlamaConfig, pool, history, last_tokens,
                 axis=1)                                    # [B, r_nodes]
             logits, pool = _tree_verify_once(
                 params, cfg, pool, tree_tokens, page_tables, dev_lengths,
-                depth, anc, use_pallas, mesh)
+                depth, anc, k, n_branches, use_pallas, mesh)
             node_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             t_root = node_t[:, 0]
             btarg = node_t[:, 1:].reshape(B, n_branches, k)
@@ -992,6 +999,25 @@ def set_last_token(last_tokens: jax.Array, idx: jax.Array,
     return last_tokens.at[idx].set(tok.astype(last_tokens.dtype))
 
 
+@functools.partial(jax.jit, static_argnames=("all_greedy", "any_top_k",
+                                             "any_top_p"),
+                   donate_argnames=("last_tokens",))
+# graftlint: hot-path
+def sample_token_into(last_tokens: jax.Array, idx: jax.Array,
+                      logits: jax.Array, temperature, top_p, top_k, key,
+                      all_greedy: bool = True, any_top_k: bool = False,
+                      any_top_p: bool = False):
+    """sample_token + set_last_token in ONE dispatch (the
+    engine.fused_sampling finish path): sample a first token from [V]
+    logits and scatter it into the device token buffer without the
+    logits ever feeding a second program. Exactly sample_token's math
+    and key consumption, so greedy streams are bitwise-identical to
+    the two-dispatch path. Returns (tok0 [], last_tokens)."""
+    tok = sample_token(logits, temperature, top_p, top_k, key,
+                       all_greedy, any_top_k, any_top_p)
+    return tok, last_tokens.at[idx].set(tok.astype(last_tokens.dtype))
+
+
 # ---------------------------------------------------------------------------
 # Chunked prefill (long prompts: larger than the biggest prefill bucket)
 # ---------------------------------------------------------------------------
@@ -1019,6 +1045,53 @@ def prefill_chunk_step(
     last = jnp.take_along_axis(
         logits, (valid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
     return last[0, 0], cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "use_pallas",
+                                             "sampling_flags", "mesh"),
+                   donate_argnames=("cache", "last_tokens"))
+# graftlint: hot-path
+def prefill_chunk_sample_step(
+    params, cfg: LlamaConfig, cache,
+    tokens: jax.Array,        # [1, C] FINAL chunk (padded to its bucket)
+    valid: jax.Array,         # [] valid tokens in this chunk
+    last_tokens: jax.Array,   # [B] device token buffer
+    slot_idx: jax.Array,      # [] slot receiving the first token
+    temperature, top_p, top_k,  # scalars (the finishing request's)
+    key: jax.Array,
+    use_pallas: Optional[bool] = None,
+    sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
+    mesh=None,
+):
+    """prefill_chunk_step + first-token sampling + the last_tokens
+    scatter in ONE dispatch — the engine.fused_sampling tail for the
+    chunk that COMPLETES a prompt (chunked long prefills and
+    prefix-cache-hit suffixes both finish here). Unfused, the finish
+    costs two extra beat-gap dispatches (sample_token +
+    set_last_token) whose only input is this program's own logits;
+    fused, the logits never leave the program. Exactly the unfused
+    math and key consumption: greedy streams bitwise-identical and
+    sampled draws key-identical (pinned on CPU CI; as a distinct XLA
+    program it carries the fused prefill rider's program-identity
+    caveat on TPU). Returns (tok0 [], last_tokens, cache).
+
+    The chunk half calls llama.forward directly (exactly
+    prefill_chunk_step's math) rather than the jitted wrapper — same
+    pattern as fused_decode_prefill_step, so the donated cache isn't
+    re-donated through a nested jit."""
+    from generativeaiexamples_tpu.models import llama
+
+    logits, cache = llama.forward(params, cfg, tokens, kv_cache=cache,
+                                  lengths=valid[None],
+                                  use_pallas=use_pallas, mesh=mesh)
+    chunk_last = jnp.take_along_axis(
+        logits, (valid - 1).reshape(1, 1, 1).astype(jnp.int32),
+        axis=1)[0, 0]
+    tok0 = sample_token(chunk_last, temperature, top_p, top_k, key,
+                        *sampling_flags)
+    last_tokens = last_tokens.at[slot_idx].set(
+        tok0.astype(last_tokens.dtype))
+    return tok0, last_tokens, cache
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "use_pallas",
@@ -1279,6 +1352,10 @@ class StepPlan(NamedTuple):
     rider_s_total  the rider's scratch-cache length (compile key)
     spec_state     plain decode over a speculative engine's device-
                    authoritative state (the sampled-request fallback)
+    rider_sample   the rider chunk COMPLETES its prompt and the
+                   first-token sample + last_tokens scatter ride the
+                   same dispatch (engine.fused_sampling; rider-only
+                   plans, i.e. decode_k == 0)
     """
 
     decode_k: int = 0
@@ -1287,6 +1364,7 @@ class StepPlan(NamedTuple):
     rider_width: int = 0
     rider_s_total: int = 0
     spec_state: bool = False
+    rider_sample: bool = False
 
 
 def plan_step(params, cfg: LlamaConfig, plan: StepPlan, **kw) -> dict:
@@ -1305,7 +1383,7 @@ def _plan_step(params, cfg: LlamaConfig, plan: StepPlan, *,
                pool=None, last_tokens=None, page_tables=None, lengths=None,
                active=None, temperature=None, top_p=None, top_k=None,
                rng=None, history=None, dev_lengths=None, cache=None,
-               chunk_tokens=None, chunk_valid=None,
+               chunk_tokens=None, chunk_valid=None, slot_idx=None,
                use_pallas: Optional[bool] = None,
                sampling_flags: Tuple[bool, bool, bool] = (True, False, False),
                mesh=None) -> dict:
@@ -1321,11 +1399,22 @@ def _plan_step(params, cfg: LlamaConfig, plan: StepPlan, *,
       (K, k, -, W)   fused_spec_prefill_step      (spec + rider, one jit)
       (K, 0*, -, 0)  decode_plain_spec_state_multi_step  (*spec_state)
       (0, 0, -, W)   prefill_chunk_step           (idle-lane chunk)
+      (0, 0, -, W†)  prefill_chunk_sample_step    (†rider_sample: the
+                     prompt-completing chunk, first token sampled +
+                     scattered in the same dispatch)
 
     Returns a dict of exactly the state the plan touched: "block" or
     ("targets", "counts"), plus "last_tokens"/"pool" and — per plan —
-    "dev_lengths"/"history" and "chunk_logits"/"cache"."""
+    "dev_lengths"/"history", "chunk_logits"/"cache", or "tok0" for
+    rider_sample plans."""
     if plan.decode_k == 0:
+        if plan.rider_sample:
+            tok0, last_tokens, cache = prefill_chunk_sample_step(
+                params, cfg, cache, chunk_tokens, chunk_valid,
+                last_tokens, slot_idx, temperature, top_p, top_k, rng,
+                use_pallas, sampling_flags=sampling_flags, mesh=mesh)
+            return {"tok0": tok0, "last_tokens": last_tokens,
+                    "cache": cache}
         logits, cache = prefill_chunk_step(
             params, cfg, cache, chunk_tokens, chunk_valid, use_pallas,
             mesh=mesh)

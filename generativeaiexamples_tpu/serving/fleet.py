@@ -75,7 +75,8 @@ _LOG = logging.getLogger(__name__)
 
 _COUNTER_KEYS = (
     "tokens_generated", "decode_steps", "prefill_tokens", "fused_steps",
-    "fused_prefill_tokens", "prefill_stall_beats", "prefix_hits",
+    "fused_prefill_tokens", "prefill_stall_beats",
+    "fused_sample_dispatches", "prefix_hits",
     "prefix_miss", "prefix_evictions", "prefix_hit_tokens",
     "plan_variants_compiled", "spec_fallback_steps",
     "admission_failures", "qos_preemptions",

@@ -114,9 +114,11 @@ def paged_tree_attention_reference(
 ) -> jax.Array:
     """Tree-verify attention over the bf16 page pool (any backend):
     gather-based like paged_attention_reference, plus the packed
-    tree-attention mask (see _tree_attention_core). There is no Pallas
-    tree kernel yet — the tree-verify path always takes this XLA
-    route, on TPU included."""
+    tree-attention mask (see _tree_attention_core). This is the
+    ORACLE and the non-TPU fallback for the Pallas tree kernel in
+    serving/paged_attention_tree.py, which applies the same mask
+    inside the paged flash-block loop instead of materializing
+    gathered KV (dispatch rule in that module's docstring)."""
     B, H, r, Hd = q.shape
     KH = k_pages.shape[0]
     ps = k_pages.shape[2]

@@ -54,6 +54,15 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30
 
 
+def compiler_params(**kw):
+    """pltpu compiler-params across JAX versions: the class was named
+    TPUCompilerParams through 0.4.x and CompilerParams after the
+    rename — resolve whichever this install ships."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    return cls(**kw)
+
+
 def quantize_kv(x: jax.Array, scale_dtype=jnp.float32):
     """Symmetric int8 over the last axis (head_dim): one scale per
     (…, token) row. Returns (q int8, s scale_dtype[...-1])."""
@@ -102,6 +111,29 @@ def fuse_kv(kq, ks, vq, vs):
 # ---------------------------------------------------------------------------
 
 
+def _tree_keep(pos, length, jrow, r, tree):
+    """Tree-verify keep mask over _tree_layout's packed lattice,
+    computed ARITHMETICALLY from iota values (Pallas kernels cannot
+    capture vector constants, and the lattice is regular enough that
+    no table is needed): node 0 is the root, node 1 + m*k + (d-1) is
+    branch m's depth-d draft, so t is an ancestor-or-self of j iff
+    t == 0, or both sit on the same branch with depth(t) <= depth(j).
+
+    pos: absolute kv slot ids [KH, G, bk-block]; length: row's length
+    incl. the root; jrow: query node index per G row (iota // g_base);
+    r = 1 + M*k nodes; tree = (k, n_branches) static."""
+    k, _branches = tree
+    rel = pos - (length - 1)            # kv slot offset into the tree
+    in_tree = (rel >= 0) & (rel < r)
+    # Clamped to keep the div/mod on non-negative values; the guards
+    # (jrow > 0, rel >= 1) exclude every clamped case from mattering.
+    jn = jnp.maximum(jrow - 1, 0)
+    tn = jnp.maximum(rel - 1, 0)
+    same_chain = ((jrow > 0) & (rel >= 1)
+                  & (jn // k == tn // k) & (tn % k <= jn % k))
+    return (rel < 0) | (in_tree & ((rel == 0) | same_chain))
+
+
 def _copy_block(pages_ref, layer, hbm, buf, sem, b, i, slot, *, ppcb, maxp):
     """Async copies for compute block i of row b into buffer `slot`:
     one STRIDED descriptor per page covering all kv heads AND both of
@@ -138,6 +170,7 @@ def _int8_kernel(
     page_size: int,
     batch_size: int,
     q_rep: int = 1,
+    tree=None,
 ):
     """One grid step per BATCH ROW, all kv heads + k and v together.
 
@@ -146,6 +179,17 @@ def _int8_kernel(
     sub-row j sits at sequence position length-1+j and masks
     pos < length + j. The KV stream is read ONCE for all positions —
     the whole point vs folding positions into the batch.
+
+    tree = (k, n_branches) (tree verify; requires q_rep == 1 + M*k):
+    the q_rep packed positions are engine_model._tree_layout's lattice
+    — node 0 the root at pool slot length-1, node 1 + m*k + (d-1)
+    branch m's depth-d draft at slot length-1+node. Query row j then
+    attends the committed prefix (pos < length-1) plus its ancestor-
+    or-self chain, which for this lattice is ARITHMETIC in the node
+    indices (same branch, depth <=) — the whole mask is a handful of
+    iota compares per flash block, no captured tables, no gathers
+    (Pallas kernels cannot capture vector constants). The KV stream
+    is identical to linear verify: the tree only edits the mask.
 
     Design rules, measured on a v5e through the real decode path
     (scripts/decompose_decode.py):
@@ -215,11 +259,18 @@ def _int8_kernel(
                 q, kq, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32) * ks  # [KH, G, ps]
             pos = i * bk + j * ps + lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            limit = length
-            if q_rep > 1:
-                limit = length + lax.broadcasted_iota(
-                    jnp.int32, s.shape, 1) // g_base
-            s = jnp.where(pos < limit, s, NEG_INF)
+            if tree is not None:
+                s = jnp.where(
+                    _tree_keep(pos, length,
+                               lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                               // g_base, q_rep, tree),
+                    s, NEG_INF)
+            else:
+                limit = length
+                if q_rep > 1:
+                    limit = length + lax.broadcasted_iota(
+                        jnp.int32, s.shape, 1) // g_base
+                s = jnp.where(pos < limit, s, NEG_INF)
 
             m_curr = jnp.max(s, axis=2, keepdims=True)  # [KH, G, 1]
             m_new = jnp.maximum(m_prev, m_curr)
@@ -249,7 +300,8 @@ def _pages_per_block(maxp: int, want: int) -> int:
 
 @functools.partial(jax.jit, static_argnames=("scale",
                                              "pages_per_compute_block",
-                                             "q_rep"))
+                                             "q_rep", "tree",
+                                             "interpret"))
 def paged_attention_int8(
     q: jax.Array,          # [B, H, Hd], or [B, R, H, Hd] when q_rep=R>1
     kv_pages: jax.Array,   # FULL pool [2, L, KH, P, ps, Hd] int8
@@ -262,14 +314,25 @@ def paged_attention_int8(
     scale: float | None = None,
     pages_per_compute_block: int | None = None,
     q_rep: int = 1,
+    tree=None,
+    interpret: bool = False,
 ) -> jax.Array:
     """q_rep > 1 is the speculative-verify form: R consecutive query
     positions per sequence ride the kernel's G axis, so the KV pages
     stream from HBM ONCE per sequence instead of once per position
     (folding positions into the batch costs R x the KV traffic AND
-    R x the DMA issues — the measured kernel floor)."""
+    R x the DMA issues — the measured kernel floor).
+
+    tree = (k, n_branches) STATIC (tree verify; requires
+    q_rep == 1 + n_branches*k): the positions are the packed
+    _tree_layout lattice and query row j attends the committed prefix
+    plus its ancestor-or-self chain (_tree_keep) instead of the linear
+    pos < length+j span. KV traffic is unchanged: the tree only edits
+    the in-kernel mask."""
     if pltpu is None:
         raise RuntimeError("Pallas TPU unavailable; use the reference path")
+    if tree is not None:
+        assert q_rep == 1 + tree[0] * tree[1], (q_rep, tree)
     if q_rep > 1:
         B, R, H, Hd = q.shape
         assert R == q_rep, (q.shape, q_rep)
@@ -296,7 +359,8 @@ def paged_attention_int8(
     s2 = kv_scales.reshape(2, L, KH, P, 1, ps)
 
     kernel = functools.partial(_int8_kernel, ppcb=ppcb, maxp=maxp,
-                               page_size=ps, batch_size=B, q_rep=q_rep)
+                               page_size=ps, batch_size=B, q_rep=q_rep,
+                               tree=tree)
     qmap = lambda b, Ln, T, LY, BI, IF: (b, 0, 0, 0)  # noqa: E731
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
@@ -325,8 +389,9 @@ def paged_attention_int8(
         out_shape=jax.ShapeDtypeStruct((B, KH, G, Hd), jnp.float32),
         # Sequential grid: the prefetch buffer index threads through SMEM
         # from one grid step to the next.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("arbitrary",)),
+        interpret=interpret,
     )(lengths, page_table.reshape(-1).astype(jnp.int32),
       jnp.asarray(layer, jnp.int32).reshape(1),
       jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),

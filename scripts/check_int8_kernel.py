@@ -1,81 +1,23 @@
-"""Validate + microbench the int8 narrow-scale paged-attention kernel
-on real TPU hardware (the CPU suite can't run Pallas async-copy
-kernels; tests/test_kv_int8.py covers the oracle and write paths).
+"""Thin forwarding shim — the int8 kernel check moved into the ONE
+kernel-parity entry point, scripts/bench_kernels.py --verify (which
+also covers the tree-attention twins and the fused sampling tail).
 
 Usage:  python scripts/check_int8_kernel.py [B] [maxp]
-Prints max abs error vs the dequant oracle and per-call wall time vs
-the stdlib bf16 kernel at the same geometry.
+        == python scripts/bench_kernels.py --verify [B] [maxp]
 """
 
 from __future__ import annotations
 
+import os
 import sys
-import time
 
-from generativeaiexamples_tpu.utils.platform import apply_platform_env
-
-apply_platform_env()
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from generativeaiexamples_tpu.serving.paged_attention import (
-    paged_attention_dispatch)
-from generativeaiexamples_tpu.serving.paged_attention_int8 import (
-    fuse_kv, paged_attention_int8, paged_attention_int8_reference,
-    quantize_kv)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
-    maxp = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-    H, KH, Hd, ps = 32, 8, 128, 128  # llama3-8b geometry, int8 page size
-    P = B * maxp + 1
-    key = jax.random.PRNGKey(0)
-    ks_ = jax.random.split(key, 4)
-    q = jax.random.normal(ks_[0], (B, H, Hd), jnp.float32).astype(jnp.bfloat16)
-    k = jax.random.normal(ks_[1], (KH, P, ps, Hd), jnp.float32)
-    v = jax.random.normal(ks_[2], (KH, P, ps, Hd), jnp.float32)
-    kq, ks = quantize_kv(k)
-    vq, vs = quantize_kv(v)
-    kv, s = fuse_kv(kq, ks, vq, vs)
-    rng = np.random.default_rng(0)
-    table = np.zeros((B, maxp), np.int32)
-    perm = rng.permutation(np.arange(1, P))
-    for b in range(B):
-        table[b] = perm[b * maxp:(b + 1) * maxp]
-    table = jnp.asarray(table)
-    lengths = jnp.asarray(
-        rng.integers(1, maxp * ps + 1, (B,)).astype(np.int32))
+    from scripts import bench_kernels
 
-    kv_full, s_full = kv[:, None], s[:, None]  # L=1 pool
-    got = paged_attention_int8(q, kv_full, s_full, table, lengths, 0)
-    want = paged_attention_int8_reference(
-        q.astype(jnp.float32), kq, ks, vq, vs, table, lengths)
-    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
-    ref_mag = float(jnp.max(jnp.abs(want)))
-    print(f"[int8-kernel] B={B} maxp={maxp} max_abs_err={err:.4e} "
-          f"(ref magnitude {ref_mag:.3f})")
-    assert err < 3e-2 * max(1.0, ref_mag), "kernel does not match oracle"
-
-    def timeit(fn, n=50):
-        fn()  # compile
-        jax.block_until_ready(fn())
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn()
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / n * 1e3
-
-    t_int8 = timeit(lambda: paged_attention_int8(q, kv_full, s_full, table,
-                                                 lengths, 0))
-    kb = k.astype(jnp.bfloat16)
-    vb = v.astype(jnp.bfloat16)
-    t_bf16 = timeit(lambda: paged_attention_dispatch(q, kb, vb, table,
-                                                     lengths))
-    print(f"[int8-kernel] per-call: int8 {t_int8:.3f} ms vs stdlib bf16 "
-          f"{t_bf16:.3f} ms  (x{t_bf16 / t_int8:.2f})")
+    bench_kernels.main(["--verify"] + sys.argv[1:])
 
 
 if __name__ == "__main__":

@@ -56,13 +56,19 @@
 #                   identical to colocated greedy, kv_transfer_pages
 #                   > 0, prefill-role never decodes, broken-transfer
 #                   fallback stays byte-identical and counted).
-#  11. flight smoke — CPU gate for the engine flight recorder
+#  11. kernel smoke — CPU gate for the Pallas tree-attention kernels
+#                   + fused sampling tail (scripts/smoke_kernels.py:
+#                   interpret-mode kernels == XLA references, fused
+#                   first-token tail == unfused sample bitwise, and
+#                   reference-route vs forced-kernel engine streams
+#                   byte-identical, bf16 and int8 pools).
+#  12. flight smoke — CPU gate for the engine flight recorder
 #                   (scripts/smoke_flight.py: recorder on by default,
 #                   beat records >= decode_steps, recorder-on vs -off
 #                   token streams byte-identical, timeline JSON loads
 #                   and spans nest, analyzer attribution sums ~100%,
 #                   overhead <= 1% on paired bursts).
-#  12. tier-1 tests — the ROADMAP.md pytest gate.
+#  13. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -115,6 +121,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "disagg smoke (JAX_PLATFORMS=cpu scripts/smoke_disagg.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_disagg.py || fail=1
+
+    step "kernel smoke (JAX_PLATFORMS=cpu scripts/smoke_kernels.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_kernels.py || fail=1
 
     step "flight smoke (JAX_PLATFORMS=cpu scripts/smoke_flight.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_flight.py || fail=1

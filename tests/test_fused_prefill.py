@@ -220,12 +220,22 @@ class TestTailChunkBucketing:
         two width >= the tail instead of padding to the full chunk."""
         widths = []
         real = engine_model.prefill_chunk_step
+        real_sample = engine_model.prefill_chunk_sample_step
 
         def spy(params, cfg, cache, tokens, *a, **k):
             widths.append(tokens.shape[1])
             return real(params, cfg, cache, tokens, *a, **k)
 
+        def sample_spy(params, cfg, cache, tokens, *a, **k):
+            # The prompt-completing chunk rides the fused-sampling
+            # tail (engine.fused_sampling default-on) — same width
+            # accounting.
+            widths.append(tokens.shape[1])
+            return real_sample(params, cfg, cache, tokens, *a, **k)
+
         monkeypatch.setattr(engine_model, "prefill_chunk_step", spy)
+        monkeypatch.setattr(engine_model, "prefill_chunk_sample_step",
+                            sample_spy)
         eng = _engine()
         prompt = [(i * 7) % TINY.vocab_size for i in range(150)]  # tail 6
         req = GenRequest(prompt_ids=prompt, max_new_tokens=2)

@@ -1178,3 +1178,53 @@ class TestShippedTree:
     def test_cli_exit_0_on_shipped_tree(self):
         proc = run_cli("generativeaiexamples_tpu/")
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestKernelHotPathMarkers:
+    """PR 15 pin: the tree-kernel dispatchers and the fused-sampling
+    tail carry `# graftlint: hot-path` markers the linter actually
+    SEES — a host sync seeded into the real source of each marked
+    function must fire GL401 (and the unseeded copy must not). If a
+    refactor moves the marker off the def line, these fail before the
+    coverage silently evaporates."""
+
+    # (relative source path, unique anchor line inside the marked
+    # function, sync statement seeded right BEFORE it)
+    CASES = [
+        # paged_tree_attention_dispatch (bf16 twin)
+        ("serving/paged_attention_tree.py",
+         "    from generativeaiexamples_tpu.serving.paged_attention "
+         "import (\n        paged_tree_attention_reference)\n",
+         "    jax.block_until_ready(q)\n"),
+        # paged_tree_attention_int8_dispatch
+        ("serving/paged_attention_tree.py",
+         "    from generativeaiexamples_tpu.serving.paged_attention "
+         "import (\n        paged_tree_attention_int8_reference_fused)\n",
+         "    jax.block_until_ready(q)\n"),
+        # sample_token_into (fused finish)
+        ("serving/engine_model.py",
+         "    tok = sample_token(logits, temperature, top_p, top_k, key,\n"
+         "                       all_greedy, any_top_k, any_top_p)\n",
+         "    jax.block_until_ready(last_tokens)\n"),
+        # prefill_chunk_sample_step (fused chunk tail)
+        ("serving/engine_model.py",
+         "    tok0 = sample_token(chunk_last, temperature, top_p, top_k, "
+         "key,\n                        *sampling_flags)\n",
+         "    jax.block_until_ready(chunk_last)\n"),
+    ]
+
+    @pytest.mark.parametrize("case", range(4))
+    def test_seeded_sync_fires_gl401(self, case, tmp_path):
+        rel, anchor, sync = self.CASES[case]
+        src = open(os.path.join(PKG, rel)).read()
+        assert src.count(anchor) == 1, (
+            f"anchor line no longer unique/present in {rel}; update "
+            f"TestKernelHotPathMarkers.CASES")
+        clean_root = write_tree(tmp_path / "clean", {"mod.py": src})
+        gl401 = [f for f in lint_paths([clean_root]) if f.check == "GL401"]
+        assert gl401 == [], [f.format() for f in gl401]
+        seeded = src.replace(anchor, sync + anchor, 1)
+        bad_root = write_tree(tmp_path / "seeded", {"mod.py": seeded})
+        gl401 = [f for f in lint_paths([bad_root]) if f.check == "GL401"]
+        assert len(gl401) == 1, [f.format() for f in gl401]
+        assert "block_until_ready" in gl401[0].message

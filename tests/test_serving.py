@@ -857,17 +857,27 @@ class TestChunkedPrefill:
 
         order = []
         real_chunk = em.prefill_chunk_step
+        real_chunk_sample = em.prefill_chunk_sample_step
         real_decode = em.decode_multi_step
 
         def chunk_spy(*a, **k):
             order.append("chunk")
             return real_chunk(*a, **k)
 
+        def chunk_sample_spy(*a, **k):
+            # The prompt-completing chunk rides the fused-sampling
+            # tail (engine.fused_sampling default-on) — still one
+            # chunk dispatch for interleave accounting.
+            order.append("chunk")
+            return real_chunk_sample(*a, **k)
+
         def decode_spy(*a, **k):
             order.append("decode")
             return real_decode(*a, **k)
 
         monkeypatch.setattr(em, "prefill_chunk_step", chunk_spy)
+        monkeypatch.setattr(em, "prefill_chunk_sample_step",
+                            chunk_sample_spy)
         monkeypatch.setattr(em, "decode_multi_step", decode_spy)
 
         params = llama.init_params(TINY, jax.random.PRNGKey(3))
