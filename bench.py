@@ -44,7 +44,8 @@ replayed trace, the SLO, and when the replica kill fires — a
 CPU-backend child process, see scripts/bench_chaos.py),
 BENCH_DISAGG (0 skips; BENCH_DISAGG_PROMPT / _XFERS / _STORM /
 _STORM_PROMPT / _SHORTS / _SHORT_PROMPT / _SHORT_GAP_S / _SLO_S tune
-the transfer microbench and the prefill-storm workload — a
+the transfer microbench and the prefill-storm workload,
+BENCH_DISAGG_SPAWN=0 skips the process-replica spawn scenario — a
 CPU-backend child process, see scripts/bench_disagg.py).
 
 Flags: --repeat N runs the headline decode burst N times and reports
@@ -198,13 +199,26 @@ Scenario output keys (under "extras"):
                  /debug/timeline control lanes. CPU-backend child
                  (scripts/bench_chaos.py). BENCH_CHAOS=0 skips)
   BENCH_DISAGG   disagg_transfer_ms_per_page / _bytes_per_page /
+                 disagg_device_path_ms_per_page (the same microbench
+                 over the device-to-device fast path — no
+                 serialization, no host bounce) /
                  disagg_ttft_storm_p95_ms vs
                  colocated_ttft_storm_p95_ms /
-                 disagg_vs_colocated_goodput (a prefill-role ->
-                 decode-role KV page transfer microbench, then short
-                 latency-tier requests timed while long chunked
-                 prefills storm a 2-replica fleet — two-stage
-                 disaggregated plans vs the colocated baseline,
+                 disagg_vs_colocated_goodput /
+                 disagg_pipelined_ttft_storm_p50_ms / _p95_ms /
+                 disagg_transfer_chunks / disagg_early_admits /
+                 disagg_transfer_overlap_pct (share of transfer wall
+                 time hidden under the prefill tail; > 0 = the
+                 pipelined chunk-ship path engaged) /
+                 disagg_spawn_ready_ms / disagg_spawn_ttft_ms (one
+                 process-per-replica worker spawned and served
+                 through, the autoscaler's process lane;
+                 BENCH_DISAGG_SPAWN=0 skips just this) — a
+                 prefill-role -> decode-role KV page transfer
+                 microbench (host bounce then device path), then
+                 short latency-tier requests timed while long chunked
+                 prefills storm a 2-replica fleet — colocated vs
+                 serialized two-stage vs pipelined two-stage plans,
                  serving/disagg.py. CPU-backend child
                  (scripts/bench_disagg.py). BENCH_DISAGG=0 skips)
 

@@ -509,6 +509,24 @@ class FleetConfig:
     disagg_prefill_timeout_s: float = 120.0
     # Deadline for the export -> import page transfer itself.
     disagg_transfer_timeout_s: float = 60.0
+    # Pipelined prefill-overlap transfer: completed prefill pages ship
+    # to the decode replica in chunks WHILE later chunks still
+    # compute, and decode admits as soon as the covered prefix lands
+    # (instead of waiting for the whole prefill + one monolithic
+    # transfer). Off = the serialized PR-14 plan, byte-identical.
+    disagg_pipeline: bool = False
+    # Device-path KV transfer: when both replicas' pools are
+    # addressable from this process (in-process fleet on one host /
+    # slice — mesh.devices_colocated), pages move device-to-device
+    # (int8 codes + scales verbatim, no serialization, no host
+    # bounce). Any device-path failure permanently falls back to the
+    # GKVT host-bounce wire for that replica pair, on the same
+    # stream. Off = every transfer takes the host bounce.
+    disagg_device_path: bool = False
+    # Transfer chunk size in PAGES for the pipelined/chunked path
+    # (each chunk is one export->import window). 0 = whole-prefix
+    # windows (chunking only at the pager's max_pages gather bound).
+    disagg_transfer_chunk_pages: int = 0
     # -- elastic autoscaler (serving/autoscaler.py). Off by default:
     # the static fleet is byte-identical with autoscale=False.
     autoscale: bool = False
@@ -545,6 +563,17 @@ class FleetConfig:
     # prefill and decode pools scale independently.
     autoscale_up_queue_wait_p95_ms: float = 0.0
     autoscale_up_ttft_p95_ms: float = 0.0
+    # How scale-up SPAWNS new replicas once the warm pool is empty:
+    # "local" builds an in-process engine (engine_factory, the PR-15
+    # behavior); "process" launches a `python -m
+    # generativeaiexamples_tpu.serving` subprocess per replica
+    # (ROADMAP 3b — process isolation, own device footprint) and
+    # joins it over HTTP once its /health answers. The child inherits
+    # this process's APP_CONFIG_FILE / APP_* environment.
+    autoscale_spawn: str = "local"
+    # How long a process spawn may take to answer /health before the
+    # subprocess is killed and the scale-up counts as failed.
+    autoscale_spawn_ready_timeout_s: float = 120.0
     # -- chaos harness (serving/chaos.py). Off by default; on, the
     # fleet carries an armed ChaosMonkey (live chaos_injected_*
     # counters, a "chaos" /debug/timeline lane) for fault drills —

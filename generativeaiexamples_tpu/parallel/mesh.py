@@ -175,6 +175,36 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def devices_colocated(a, b) -> bool:
+    """Are every device in `a` and `b` addressable from THIS process —
+    i.e. can jax.device_put move arrays between them without
+    serialization (one host driving one slice, chip-to-chip over ICI)?
+    This is the gate for the disagg device-path KV transfer
+    (serving/disagg.py KVPageTransfer.device_ok): on CPU both engine
+    pools live on the same local device, on a single-host TPU slice
+    the replicas' chips share the ICI domain. Empty sets are NOT
+    colocated — an engine with no live arrays has no path."""
+    a, b = set(a), set(b)
+    if not a or not b:
+        return False
+    local = set(jax.local_devices())
+    return a <= local and b <= local
+
+
+def dcn_transfer_available() -> bool:
+    """Is the cross-host (DCN) device-path leg available — multi-host
+    jax.distributed initialized, so a collective program over the
+    `pipeline`/`data` DCN axes could move pages between hosts without
+    the host bounce? Today this only REPORTS the condition: the
+    transfer itself still takes the `/v1/kv/export` wire between
+    process-separated replicas (each process owns a distinct engine;
+    a cross-process collective needs a shared global program both
+    sides enter, which the serving loop does not yet schedule). The
+    gate exists so KVPageTransfer and the docs state the boundary
+    honestly instead of implying ICI semantics across DCN."""
+    return is_multihost()
+
+
 def maybe_initialize_distributed() -> None:
     """Multi-host init (DCN): no-op unless JAX_COORDINATOR_ADDRESS is set;
     on pods this wires jax.distributed so device lists span hosts

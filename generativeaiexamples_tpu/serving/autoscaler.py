@@ -75,6 +75,7 @@ class FleetAutoscaler:
     """
 
     def __init__(self, fleet, engine_factory: Optional[Callable] = None,
+                 replica_factory: Optional[Callable] = None,
                  min_replicas: int = 1, max_replicas: int = 4,
                  warm_pool: int = 1, interval_s: float = 2.0,
                  up_depth: float = 8.0, down_depth: float = 1.0,
@@ -87,6 +88,12 @@ class FleetAutoscaler:
                  hist_fn: Optional[Callable] = None):
         self.fleet = fleet
         self.engine_factory = engine_factory
+        # Process-per-replica spawn lane (ROADMAP 3b): a callable
+        # (rid, role) -> started, ready replica (fleet.py
+        # spawn_process_replica). When set it REPLACES the
+        # engine_factory lane — scale-up launches a subprocess per
+        # replica instead of building an in-process engine.
+        self.replica_factory = replica_factory
         self.min_replicas = max(0, int(min_replicas))
         self.max_replicas = max(1, int(max_replicas))
         self.warm_pool = max(0, int(warm_pool))
@@ -382,7 +389,8 @@ class FleetAutoscaler:
             if cand is not None:
                 self.fleet.restore(cand.rid)
                 rid = cand.rid
-            elif (self.engine_factory is not None
+            elif ((self.engine_factory is not None
+                   or self.replica_factory is not None)
                   and len(self.fleet.replicas) < self.max_replicas):
                 rid = None
             else:
@@ -448,30 +456,43 @@ class FleetAutoscaler:
         return True
 
     def _spawn(self, admitting: bool) -> Optional[str]:
-        """Build + register a fresh local replica (engine_factory
-        path). Runs on the controller thread OUTSIDE the decision
-        lock — spawning is the slow scale-up lane, waking the warm
-        pool the fast one."""
-        try:
-            engine = self.engine_factory()
-        except Exception:
-            _LOG.exception("autoscaler engine_factory failed")
-            return None
+        """Build + register a fresh replica. Runs on the controller
+        thread OUTSIDE the decision lock — spawning is the slow
+        scale-up lane, waking the warm pool the fast one. Two lanes:
+        replica_factory launches a process-per-replica worker
+        (subprocess + readiness probe, already started when it
+        returns); engine_factory builds an in-process engine wrapped
+        in a LocalReplica."""
         with self._lock:
             self._spawned += 1
             rid = f"as{self._spawned}"
             role = self._hot_role or "mixed"
-        replica = LocalReplica(rid, engine)
-        replica.role = role  # joins the hot pool (disagg roles)
-        replica.start()
+        if self.replica_factory is not None:
+            try:
+                replica = self.replica_factory(rid, role)
+            except Exception:
+                # Never silent (GL302): a dead spawn lane must show up,
+                # and the reserved cooldown stops hot-looping it.
+                _LOG.exception("autoscaler replica_factory failed")
+                return None
+            replica.role = role
+        else:
+            try:
+                engine = self.engine_factory()
+            except Exception:
+                _LOG.exception("autoscaler engine_factory failed")
+                return None
+            replica = LocalReplica(rid, engine)
+            replica.role = role  # joins the hot pool (disagg roles)
+            replica.start()
         self.fleet.add_replica(replica, admitting=admitting)
         return rid
 
     def _ensure_warm_pool(self) -> None:
         """Pre-warm the configured pool at start(): spawn parked-warm
-        replicas until `warm_pool` non-active spares exist (needs an
-        engine_factory and max_replicas headroom)."""
-        if self.engine_factory is None:
+        replicas until `warm_pool` non-active spares exist (needs a
+        spawn lane and max_replicas headroom)."""
+        if self.engine_factory is None and self.replica_factory is None:
             return
         while True:
             with self._lock:

@@ -232,7 +232,8 @@ class _LongPrefill:
     decode traffic, chunks run at full dispatch speed."""
 
     __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot",
-                 "beat", "chunk", "stall_pos", "tier", "paused")
+                 "beat", "chunk", "stall_pos", "tier", "paused",
+                 "published")
 
     def __init__(self, req, slot_idx, seq, ids, cache, slot, chunk):
         self.req = req
@@ -242,6 +243,12 @@ class _LongPrefill:
         self.cache = cache
         self.pos = 0  # next prompt offset to feed
         self.slot = slot  # the placeholder occupying slots[slot_idx]
+        # Pages already scattered into the pool + inserted into the
+        # radix tree by publish_prefill_pages() (the pipelined-disagg
+        # seam): the finish scatter sinks these rows so each page is
+        # written exactly once, and the final insert dedups against
+        # the already-published prefix.
+        self.published = 0
         self.beat = -1  # reader beat at which the last chunk dispatched
         # pos observed at the last beat boundary (-1 = not yet seen);
         # drives the prefill_stall_beats counter.
@@ -324,6 +331,13 @@ class EngineMetrics:
         # summed fleet-wide via fleet._COUNTER_KEYS.
         self.kv_transfer_pages = 0
         self.kv_transfer_ms = 0.0
+        # Device-path / chunked transfer (PR 17): pages that arrived as
+        # device arrays (zero host serialization — the ICI fast path)
+        # and import calls total (each chunk of a pipelined transfer is
+        # one import control op). Always present — 0, never absent,
+        # when the device path / chunking is off.
+        self.kv_transfer_device_pages = 0
+        self.kv_transfer_chunks = 0
         # QoS counters (serving/qos.py; always present — 0, never
         # absent, when engine.qos is off): admissions that failed on
         # page exhaustion (requeued or, past MAX_ADMISSION_RETRIES,
@@ -430,6 +444,8 @@ class EngineMetrics:
             "spec_fallback_steps": self.spec_fallback_steps,
             "kv_transfer_pages": self.kv_transfer_pages,
             "kv_transfer_ms": round(self.kv_transfer_ms, 3),
+            "kv_transfer_device_pages": self.kv_transfer_device_pages,
+            "kv_transfer_chunks": self.kv_transfer_chunks,
             "admission_failures": self.admission_failures,
             "qos_preemptions": self.qos_preemptions,
             "stuck_thread_joins": self.stuck_thread_joins,
@@ -1380,31 +1396,16 @@ class LLMEngine:
             finally:
                 done.set()
 
-    def export_prefix_pages(self, ids: Sequence[int]):
-        """Longest cached full-page prefix of `ids` as HOST bytes —
-        the disagg transfer's source half (serving/disagg.py): one
-        batched pool_to_pages gather for the device-resident run,
-        plus (with engine.kv_pager) a tier-lock read of any demoted
-        tail, codes + int8 scales VERBATIM so a transfer round trip
-        is bit-identical to never having left this pool. Returns
-        (codes [n,2,L,KH,ps,Hd], scales [n,2,L,KH,ps]|None, n_tokens)
-        or None when nothing is cached. Scheduler thread only — the
-        fleet calls in via run_control_op. The blocking device->host
-        fetch is by design: it IS the transfer cost the bench meters.
-        """
-        from generativeaiexamples_tpu.serving.disagg import page_geometry
+    def _cached_page_runs(self, ids: Sequence[int]):
+        """Longest exportable cached prefix of `ids` as two node runs:
+        the device-resident lead (the resident set is ancestor-closed)
+        and — with engine.kv_pager — the demoted tail readable straight
+        from its cold tier. A TIER_PENDING node ends the run (its bytes
+        are mid-flight to the host)."""
         from generativeaiexamples_tpu.serving.prefix_cache import (
             TIER_DEVICE, TIER_DISK, TIER_HOST)
 
-        if self.prefix_cache is None:
-            return None
         nodes = self.prefix_cache.match_nodes(list(ids))
-        if not nodes:
-            return None
-        # Resident prefix first (the resident set is ancestor-closed),
-        # then — with the pager — the demoted tail straight from its
-        # cold tier, no promotion dispatch. A TIER_PENDING node ends
-        # the run (its bytes are mid-flight to the host).
         dev: List = []
         for n in nodes:
             if n.tier != TIER_DEVICE:
@@ -1416,50 +1417,177 @@ class LLMEngine:
                 if n.tier not in (TIER_HOST, TIER_DISK):
                     break
                 cold.append(n)
-        n_pages = len(dev) + len(cold)
-        if n_pages == 0:
+        return dev, cold
+
+    def export_prefix_pages(self, ids: Sequence[int],
+                            start_page: int = 0, max_pages: int = 0):
+        """Longest cached full-page prefix of `ids` as HOST bytes —
+        the disagg transfer's source half (serving/disagg.py): batched
+        pool_to_pages gathers for the device-resident run — chunked at
+        the pager granularity (self.max_pages), the PR-11 demotion
+        idiom, so a large transfer never holds the scheduler's
+        control-op slot for one monolithic gather — plus (with
+        engine.kv_pager) a tier-lock read of any demoted tail, codes +
+        int8 scales VERBATIM so a transfer round trip is bit-identical
+        to never having left this pool. `start_page`/`max_pages`
+        select a page window of the cached prefix (defaults: all of
+        it) for chunked/pipelined transfers. Returns
+        (codes [n,2,L,KH,ps,Hd], scales [n,2,L,KH,ps]|None, n_tokens)
+        where n_tokens covers the prefix through the END of the
+        window — so ids[:n_tokens] plus first_page=start_page is the
+        matching import call — or None when the window is empty.
+        Scheduler thread only — the fleet calls in via run_control_op.
+        The blocking device->host fetch is by design: it IS the
+        transfer cost the bench meters."""
+        from generativeaiexamples_tpu.serving.disagg import page_geometry
+        from generativeaiexamples_tpu.serving.kv_pager import gather_spans
+
+        if self.prefix_cache is None:
+            return None
+        dev, cold = self._cached_page_runs(ids)
+        n_total = len(dev) + len(cold)
+        lo = max(0, int(start_page))
+        hi = n_total if max_pages <= 0 else min(n_total,
+                                                lo + int(max_pages))
+        n_pages = hi - lo
+        if n_pages <= 0:
             return None
         codes_shape, codes_dtype, scales_shape = page_geometry(self.pool)
         codes = np.zeros((n_pages,) + codes_shape, codes_dtype)
         scales = (np.zeros((n_pages,) + scales_shape, np.float32)
                   if scales_shape else None)
-        if dev:
+        dev_w = dev[lo:hi]
+        for s_lo, s_hi in gather_spans(len(dev_w), self.max_pages):
+            batch = dev_w[s_lo:s_hi]
             w = 1
-            while w < len(dev):
+            while w < len(batch):
                 w *= 2
             row = np.zeros((w,), np.int32)  # padding -> sink page 0
-            row[: len(dev)] = [n.page for n in dev]
+            row[: len(batch)] = [n.page for n in batch]
             got, got_s = engine_model.pool_to_pages(self.pool,
                                                     self._put(row))
-            codes[: len(dev)] = np.asarray(got)[: len(dev)]
+            codes[s_lo:s_hi] = np.asarray(got)[: len(batch)]
             if scales is not None:
-                scales[: len(dev)] = np.asarray(got_s)[: len(dev)]
-        if cold:
+                scales[s_lo:s_hi] = np.asarray(got_s)[: len(batch)]
+        cold_w = cold[max(lo - len(dev), 0): max(hi - len(dev), 0)]
+        if cold_w:
             self.kv_pager.read_pages(
-                cold, codes[len(dev):],
-                None if scales is None else scales[len(dev):])
-        return codes, scales, n_pages * self.pool.page_size
+                cold_w, codes[len(dev_w):],
+                None if scales is None else scales[len(dev_w):])
+        return codes, scales, hi * self.pool.page_size
 
-    def import_prefix_pages(self, ids: Sequence[int], codes: np.ndarray,
-                            scales: Optional[np.ndarray]) -> int:
+    # graftlint: hot-path
+    def export_prefix_pages_device(self, ids: Sequence[int],
+                                   start_page: int = 0,
+                                   max_pages: int = 0):
+        """Device-path export half (the ICI fast path): the window's
+        device-RESIDENT pages as jax.Arrays straight off one batched
+        pool_to_pages gather — no np.asarray, no host sync, zero
+        serialization; the caller hands the arrays to the target
+        engine's import_prefix_pages where device_put moves them
+        chip-to-chip over ICI (int8 codes + f32 scales verbatim, so
+        the route is bit-identical to the GKVT host bounce). Only the
+        leading TIER_DEVICE run participates — a pager-demoted cold
+        tail must take the host path. Each call caps its window at
+        self.max_pages so every gather width is a warmed power-of-two
+        variant; callers loop on the returned n_tokens. Returns
+        (codes, scales|None, n_tokens) like export_prefix_pages, or
+        None when the window holds no device-resident pages.
+        Scheduler thread only — run_control_op."""
+        if self.prefix_cache is None:
+            return None
+        dev, _ = self._cached_page_runs(ids)
+        lo = max(0, int(start_page))
+        hi = len(dev) if max_pages <= 0 else min(len(dev),
+                                                 lo + int(max_pages))
+        hi = min(hi, lo + self.max_pages)
+        n_pages = hi - lo
+        if n_pages <= 0:
+            return None
+        w = 1
+        while w < n_pages:
+            w *= 2
+        row = np.zeros((w,), np.int32)  # padding -> sink page 0
+        row[:n_pages] = [n.page for n in dev[lo:hi]]
+        got, got_s = engine_model.pool_to_pages(self.pool, self._put(row))
+        return (got[:n_pages],
+                None if got_s is None else got_s[:n_pages],
+                hi * self.pool.page_size)
+
+    def publish_prefill_pages(self, ids: Sequence[int]) -> int:
+        """Make the COMPLETED chunks of an in-flight chunked prefill
+        for `ids` exportable now — the pipelined-disagg seam: scatter
+        the newly covered full pages from the scratch cache into the
+        pool (same cache_to_pool variant the finish scatter compiles —
+        per-page quantization makes incremental scatters bit-identical
+        to the one-shot) and insert the covered prefix into the radix
+        tree, so export_prefix_pages can ship those pages while later
+        chunks are still computing. Idempotent and monotone: each call
+        publishes only pages newly completed since the last; the
+        finish scatter sinks already-published rows so every page is
+        written exactly once. With no matching in-flight prefill
+        (finished, or never chunked) returns the exportable coverage
+        already in the tree. Returns covered full pages. Scheduler
+        thread only — run_control_op."""
+        if self.prefix_cache is None:
+            return 0
+        ids = list(ids)
+        ps = self.pool.page_size
+        n_full = len(ids) // ps
+        if n_full <= 0:
+            return 0
+        for lp in self._long_prefills:
+            if (lp.ids != ids or self.slots[lp.slot_idx] is not lp.slot
+                    or lp.req.cancelled):
+                continue
+            covered = min(lp.pos // ps, n_full)
+            done = max(lp.published, lp.seq.n_shared)
+            if covered > done:
+                S_total = lp.cache.k.shape[-2]
+                row = np.zeros((S_total // ps,), np.int32)  # sink 0
+                row[done:covered] = lp.seq.pages[done:covered]
+                self.pool = engine_model.cache_to_pool(
+                    self.pool, lp.cache, self.cfg, self._put(row))
+            if covered > lp.published:
+                self.prefix_cache.insert(ids[: covered * ps],
+                                         lp.seq.pages[:covered])
+                freed = self.prefix_cache.trim()
+                if freed:
+                    self.metrics.prefix_evictions += freed
+                lp.published = covered
+            return lp.published
+        dev, cold = self._cached_page_runs(ids)
+        return min(len(dev) + len(cold), n_full)
+
+    def import_prefix_pages(self, ids: Sequence[int], codes,
+                            scales, first_page: int = 0) -> int:
         """Seat transferred page bytes into this engine's pool and
         radix tree — the disagg transfer's target half: allocate pool
         pages (reclaim may demote cold sessions, exactly like a
         promote), ONE pages_to_pool scatter, then insert the prefix
         into the tree so the very next admission takes the normal
         prefix-cache hit path (zero re-prefill of the transferred
-        prefix). Returns pages imported (0 when the prefix is already
+        prefix). `codes` is either host np.ndarrays (the GKVT wire) or
+        device jax.Arrays (the ICI fast path — staged on device,
+        device_put to this engine's placement, never touching the
+        host); `first_page` says which page of ids' prefix codes[0]
+        covers, so a chunked/pipelined transfer imports window by
+        window and each import dedups against what already landed.
+        Returns pages imported (0 when the prefix is already
         resident); raises MemoryError when the allocator cannot cover
-        the pages even after reclaim (the fleet falls back to
-        colocated serving). Scheduler thread only — run_control_op."""
+        the pages even after reclaim, ValueError when the window
+        starts past the resident prefix (a gap — the fleet falls back
+        to colocated serving either way). Scheduler thread only —
+        run_control_op."""
         from generativeaiexamples_tpu.serving.prefix_cache import (
             TIER_DEVICE)
 
         if self.prefix_cache is None:
             raise RuntimeError("KV import needs engine.prefix_cache")
         ps = self.pool.page_size
-        n = min(int(codes.shape[0]), len(ids) // ps)
-        if n <= 0:
+        first = max(0, int(first_page))
+        n = min(first + int(codes.shape[0]), len(ids) // ps)
+        if n <= first:
             return 0
 
         def resident_run(upto_pages: int) -> List:
@@ -1478,6 +1606,12 @@ class LLMEngine:
         have = len(resident_run(n))
         if have >= n:
             return 0  # already resident: the hit path serves as-is
+        if have < first:
+            raise ValueError(
+                f"import window starts at page {first} but only "
+                f"{have} pages of the prefix are resident — a chunk "
+                "gap (an earlier window failed or was evicted)")
+        device = not isinstance(codes, np.ndarray)
         t0 = time.perf_counter()
         m = n - have
         pages = self.allocator.alloc(m)
@@ -1492,18 +1626,35 @@ class LLMEngine:
             w = 1
             while w < m:
                 w *= 2
-            buf = np.zeros((w,) + codes.shape[1:], codes.dtype)
-            buf[:m] = codes[have:n]
             row = np.zeros((w,), np.int32)  # padding -> sink page 0
             row[:m] = pages
-            sbuf = None
-            if scales is not None:
-                sbuf = np.zeros((w,) + scales.shape[1:], np.float32)
-                sbuf[:m] = scales[have:n]
+            if device:
+                # Stage the pad on device and move straight to this
+                # engine's placement — no host round trip, the whole
+                # point of the fast path.
+                buf = jnp.zeros((w,) + tuple(codes.shape[1:]),
+                                codes.dtype).at[:m].set(
+                                    codes[have - first: n - first])
+                sbuf = None
+                if scales is not None:
+                    sbuf = jnp.zeros((w,) + tuple(scales.shape[1:]),
+                                     jnp.float32).at[:m].set(
+                                         scales[have - first: n - first])
+                if self._replicated is not None:
+                    buf = jax.device_put(buf, self._replicated)
+                    if sbuf is not None:
+                        sbuf = jax.device_put(sbuf, self._replicated)
+            else:
+                hbuf = np.zeros((w,) + codes.shape[1:], codes.dtype)
+                hbuf[:m] = codes[have - first: n - first]
+                buf = self._put(hbuf)
+                sbuf = None
+                if scales is not None:
+                    hs = np.zeros((w,) + scales.shape[1:], np.float32)
+                    hs[:m] = scales[have - first: n - first]
+                    sbuf = self._put(hs)
             self.pool = engine_model.pages_to_pool(
-                self.pool, self._put(buf),
-                None if sbuf is None else self._put(sbuf),
-                self._put(row))
+                self.pool, buf, sbuf, self._put(row))
             # The leading `have` chunks are guaranteed present (just
             # re-verified, nothing evicts between here and insert on
             # this thread), so insert dedups them — their payloads
@@ -1522,6 +1673,9 @@ class LLMEngine:
         dt_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.kv_transfer_pages += m
         self.metrics.kv_transfer_ms += dt_ms
+        self.metrics.kv_transfer_chunks += 1
+        if device:
+            self.metrics.kv_transfer_device_pages += m
         self.metrics.hists["kv_transfer_ms_per_page"].observe(dt_ms / m)
         if self.flight.enabled:
             self.flight.record_event(EV_KV_TRANSFER, t0, a=float(m),
@@ -2582,9 +2736,12 @@ class LLMEngine:
         # Pages adopted read-only from the prefix cache must never be
         # rewritten: their rows scatter into the page-0 sink. (A CoW
         # tail page is NOT shared — it is rewritten whole from the
-        # scratch cache: gathered head + computed tail.)
-        if lp.seq.n_shared:
-            row[:lp.seq.n_shared] = 0
+        # scratch cache: gathered head + computed tail.) Pages already
+        # scattered by publish_prefill_pages sink too: each page is
+        # written exactly once.
+        sunk = max(lp.seq.n_shared, lp.published)
+        if sunk:
+            row[:sunk] = 0
         self.pool = engine_model.cache_to_pool(self.pool, lp.cache, self.cfg,
                                                self._put(row))
         self._insert_prefix(lp.ids, lp.seq)

@@ -83,7 +83,11 @@ _COUNTER_KEYS = (
     # Disagg KV transfer counters (serving/disagg.py): pages a decode
     # replica imported from a prefill-role replica, and the wall ms
     # those imports cost — summed fleet-wide, zeros when disagg is off.
+    # device_pages arrived as jax.Arrays over the ICI fast path
+    # (zero host serialization); chunks counts import control ops
+    # (each window of a chunked/pipelined transfer is one).
     "kv_transfer_pages", "kv_transfer_ms",
+    "kv_transfer_device_pages", "kv_transfer_chunks",
     # KV-pager counters and tier gauges (serving/kv_pager.py) sum
     # across replicas: fleet-wide parked-session pages per tier.
     "kv_demotions", "kv_promotions", "kv_promote_tokens",
@@ -109,6 +113,14 @@ FLEET_OPS_KEYS = (
     # fleet ran, and stages that fell back to colocated serving on the
     # same stream (prefill failure, transfer failure, empty export).
     "disagg_requests", "disagg_fallbacks",
+    # Pipelined-transfer plane (fleet.disagg_pipeline): wall ms of
+    # transfer windows that shipped UNDER the prefill tail (hidden
+    # from TTFT), total transfer-window wall ms (the overlap pct's
+    # denominator), decode admissions that proceeded with the final
+    # chunk still in flight, and device-path windows that fell back
+    # to the GKVT host bounce. Zeros when the knobs are off.
+    "disagg_overlap_ms", "disagg_transfer_ms",
+    "disagg_early_admits", "disagg_device_fallbacks",
 )
 
 # Chaos-injection counters (serving/chaos.py ChaosStats): zeros unless
@@ -134,6 +146,10 @@ class FleetOps:
         self.upgrade_replicas_rolled = 0
         self.disagg_requests = 0
         self.disagg_fallbacks = 0
+        self.disagg_overlap_ms = 0.0
+        self.disagg_transfer_ms = 0.0
+        self.disagg_early_admits = 0
+        self.disagg_device_fallbacks = 0
         self.stuck_thread_joins = 0
 
     def note_scale_up(self) -> None:
@@ -160,6 +176,20 @@ class FleetOps:
     def note_disagg_fallback(self) -> None:
         with self._lock:
             self.disagg_fallbacks += 1
+
+    def note_disagg_transfer(self, wall_ms: float,
+                             overlap_ms: float = 0.0) -> None:
+        with self._lock:
+            self.disagg_transfer_ms += wall_ms
+            self.disagg_overlap_ms += overlap_ms
+
+    def note_disagg_early_admit(self) -> None:
+        with self._lock:
+            self.disagg_early_admits += 1
+
+    def note_disagg_device_fallback(self) -> None:
+        with self._lock:
+            self.disagg_device_fallbacks += 1
 
     def note_stuck_join(self, n: int = 1) -> None:
         with self._lock:
@@ -285,23 +315,71 @@ class LocalReplica:
     # -- disagg KV page transfer (serving/disagg.py) -----------------------
 
     # graftlint: hot-path
-    def export_kv_pages(self, ids, timeout_s: float = 60.0):
-        """Cached full-page prefix of `ids` as host bytes, gathered on
+    def export_kv_pages(self, ids, timeout_s: float = 60.0,
+                        start_page: int = 0, max_pages: int = 0):
+        """Cached full-page prefix of `ids` (or the
+        start_page/max_pages window of it) as host bytes, gathered on
         the engine's scheduler thread (control op). None when nothing
         is cached."""
         eng = self.engine
         return eng.run_control_op(
-            lambda: eng.export_prefix_pages(ids), timeout_s=timeout_s)
+            lambda: eng.export_prefix_pages(ids, start_page, max_pages),
+            timeout_s=timeout_s)
 
     # graftlint: hot-path
     def import_kv_pages(self, ids, codes, scales,
-                        timeout_s: float = 60.0) -> int:
+                        timeout_s: float = 60.0,
+                        first_page: int = 0) -> int:
         """Seat transferred pages into the engine's pool + radix tree
         (control op). Returns pages imported."""
         eng = self.engine
         return eng.run_control_op(
-            lambda: eng.import_prefix_pages(ids, codes, scales),
+            lambda: eng.import_prefix_pages(ids, codes, scales,
+                                            first_page),
             timeout_s=timeout_s)
+
+    # graftlint: hot-path
+    def publish_kv_pages(self, ids, timeout_s: float = 60.0) -> int:
+        """Make an in-flight chunked prefill's completed pages
+        exportable now (control op) — the pipelined-transfer probe.
+        Returns covered full pages."""
+        eng = self.engine
+        return eng.run_control_op(
+            lambda: eng.publish_prefill_pages(ids), timeout_s=timeout_s)
+
+    # graftlint: hot-path
+    def export_kv_pages_device(self, ids, timeout_s: float = 60.0,
+                               start_page: int = 0, max_pages: int = 0):
+        """Device-path export: the window's device-resident pages as
+        jax.Arrays, no host sync (control op). None when the window
+        holds none."""
+        eng = self.engine
+        return eng.run_control_op(
+            lambda: eng.export_prefix_pages_device(ids, start_page,
+                                                   max_pages),
+            timeout_s=timeout_s)
+
+    # graftlint: hot-path
+    def import_kv_pages_device(self, ids, codes, scales,
+                               timeout_s: float = 60.0,
+                               first_page: int = 0) -> int:
+        """Device-path import: stage + scatter the jax.Arrays on
+        device (control op). Returns pages imported."""
+        eng = self.engine
+        return eng.run_control_op(
+            lambda: eng.import_prefix_pages(ids, codes, scales,
+                                            first_page),
+            timeout_s=timeout_s)
+
+    def transfer_page_size(self) -> int:
+        return self.engine.pool.page_size
+
+    def transfer_device_set(self):
+        """Devices holding this engine's KV pool — the device-path
+        colocation check's input (mesh.devices_colocated)."""
+        pool = self.engine.pool
+        arr = pool.kv if getattr(pool, "quantized", False) else pool.k
+        return set(arr.devices())
 
 
 class HttpReplica:
@@ -427,15 +505,25 @@ class HttpReplica:
     # -- disagg KV page transfer (serving/disagg.py over HTTP) -------------
 
     # graftlint: hot-path
-    def export_kv_pages(self, ids, timeout_s: float = 60.0):
-        """Fetch the remote replica's cached prefix for `ids` over its
-        /v1/kv/export endpoint. None when it holds nothing (204)."""
+    def export_kv_pages(self, ids, timeout_s: float = 60.0,
+                        start_page: int = 0, max_pages: int = 0):
+        """Fetch the remote replica's cached prefix for `ids` (or the
+        start_page/max_pages window of it) over its /v1/kv/export
+        endpoint. None when it holds nothing (204). The returned
+        n_tokens covers the prefix through the window's END — the ids
+        the export payload carries — matching the engine-side export
+        contract."""
         from generativeaiexamples_tpu.serving.disagg import (
             deserialize_kv_transfer)
 
-        body = json.dumps({"prompt": list(ids)}).encode()
+        body = {"prompt": list(ids)}
+        if start_page:
+            body["start_page"] = int(start_page)
+        if max_pages:
+            body["max_pages"] = int(max_pages)
         http_req = urllib.request.Request(
-            self.base_url + "/v1/kv/export", data=body,
+            self.base_url + "/v1/kv/export",
+            data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(http_req, timeout=timeout_s) as resp:
             payload = resp.read()
@@ -446,18 +534,146 @@ class HttpReplica:
 
     # graftlint: hot-path
     def import_kv_pages(self, ids, codes, scales,
-                        timeout_s: float = 60.0) -> int:
+                        timeout_s: float = 60.0,
+                        first_page: int = 0) -> int:
         """Ship pages to the remote replica's /v1/kv/import endpoint.
-        Returns pages the remote engine imported."""
+        The window offset travels in the X-KV-First-Page header — the
+        GKVT payload itself is unchanged, so old and new servers
+        interoperate (an old server ignores the header, which only
+        matters for chunked transfers it would never be asked to
+        receive). Returns pages the remote engine imported."""
         from generativeaiexamples_tpu.serving.disagg import (
             serialize_kv_transfer)
 
+        headers = {"Content-Type": "application/octet-stream"}
+        if first_page:
+            headers["X-KV-First-Page"] = str(int(first_page))
         http_req = urllib.request.Request(
             self.base_url + "/v1/kv/import",
             data=serialize_kv_transfer(list(ids), codes, scales),
-            headers={"Content-Type": "application/octet-stream"})
+            headers=headers)
         with urllib.request.urlopen(http_req, timeout=timeout_s) as resp:
             return int(json.load(resp).get("pages", 0))
+
+    # graftlint: hot-path
+    def publish_kv_pages(self, ids, timeout_s: float = 60.0) -> int:
+        """Probe/advance the remote prefill's exportable coverage via
+        /v1/kv/export {"publish": true, "probe": true} — pages only,
+        no payload. Returns covered full pages."""
+        body = json.dumps({"prompt": list(ids), "publish": True,
+                           "probe": True}).encode()
+        http_req = urllib.request.Request(
+            self.base_url + "/v1/kv/export", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(http_req, timeout=timeout_s) as resp:
+            return int(json.load(resp).get("pages", 0))
+
+
+class ProcessReplica(HttpReplica):
+    """An HttpReplica whose engine-server process THIS fleet owns: the
+    autoscaler's process-per-replica spawn lane (ROADMAP 3b). Same
+    wire surface as any remote replica — SSE proxy, /health probes,
+    the /v1/kv wire for transfers (never the device path: the engine
+    lives in another address space) — plus lifecycle: stop() and
+    eviction terminate the subprocess, healthy() also fails when the
+    process died (no point probing a socket whose owner is gone)."""
+
+    def __init__(self, rid: str, base_url: str, proc,
+                 timeout_s: float = 300.0, probe_timeout_s: float = 2.0,
+                 role: str = "mixed"):
+        super().__init__(rid, base_url, timeout_s=timeout_s,
+                         probe_timeout_s=probe_timeout_s, role=role)
+        self.proc = proc
+
+    def healthy(self) -> bool:
+        if self.proc.poll() is not None:
+            self._probe_fails += 1
+            return False
+        return super().healthy()
+
+    def stop(self) -> None:
+        """Terminate the worker process (SIGTERM, then SIGKILL after a
+        grace period). Idempotent — park(cold)/evict/fleet.stop all
+        land here."""
+        if self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10.0)
+        except Exception:
+            _LOG.warning("process replica %s ignored SIGTERM; killing",
+                         self.rid)
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=5.0)
+            except Exception:
+                pass
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def spawn_process_replica(rid: str, *, host: str = "127.0.0.1",
+                          port: int = 0, model_size: str = "tiny",
+                          config_path: str = "",
+                          ready_timeout_s: float = 120.0,
+                          probe_timeout_s: float = 2.0,
+                          role: str = "mixed",
+                          env: Optional[Dict[str, str]] = None,
+                          warm: bool = True) -> ProcessReplica:
+    """Launch one engine-server subprocess (``python -m
+    generativeaiexamples_tpu.serving``) and block until its /health
+    probe answers — the autoscaler's spawn path for process-per-
+    replica fleets. The server warms at boot (ENGINE_WARMUP=1, its
+    default) unless warm=False, so the replica joins the fleet ready
+    to serve, exactly like the LocalReplica spawn lane's warmup()
+    call. On timeout or early exit the process is killed and
+    RuntimeError raised (the autoscaler logs and retries on a later
+    tick). The child inherits this process's environment (JAX_*,
+    APP_* overrides) plus `env`."""
+    import os
+    import subprocess
+    import sys
+
+    if port <= 0:
+        port = _free_port(host)
+    cmd = [sys.executable, "-m", "generativeaiexamples_tpu.serving",
+           "--host", host, "--port", str(port),
+           "--model-size", model_size]
+    if config_path:
+        cmd += ["--config", config_path]
+    penv = dict(os.environ)
+    penv.update(env or {})
+    if not warm:
+        penv["ENGINE_WARMUP"] = "0"
+    proc = subprocess.Popen(cmd, env=penv,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    base_url = f"http://{host}:{port}"
+    deadline = time.monotonic() + ready_timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"process replica {rid} exited with code "
+                f"{proc.returncode} before becoming ready")
+        try:
+            with urllib.request.urlopen(base_url + "/health",
+                                        timeout=probe_timeout_s) as resp:
+                if json.load(resp).get("status") == "healthy":
+                    return ProcessReplica(
+                        rid, base_url, proc,
+                        probe_timeout_s=probe_timeout_s, role=role)
+        except Exception:
+            pass
+        time.sleep(0.25)
+    proc.kill()
+    raise RuntimeError(f"process replica {rid} not ready within "
+                       f"{ready_timeout_s}s")
 
 
 class _ReqRecord:
@@ -639,7 +855,10 @@ class EngineFleet:
                  disagg: bool = False,
                  disagg_min_prompt_tokens: int = 0,
                  disagg_prefill_timeout_s: float = 120.0,
-                 disagg_transfer_timeout_s: float = 60.0):
+                 disagg_transfer_timeout_s: float = 60.0,
+                 disagg_pipeline: bool = False,
+                 disagg_device_path: bool = False,
+                 disagg_transfer_chunk_pages: int = 0):
         if not replicas:
             raise ValueError("EngineFleet needs at least one replica")
         self.replicas = list(replicas)
@@ -655,19 +874,29 @@ class EngineFleet:
         self._disagg_min_prompt_tokens = max(0,
                                              int(disagg_min_prompt_tokens))
         self._disagg_prefill_timeout_s = float(disagg_prefill_timeout_s)
+        # Pipelined transfer (PR 17): ship completed prefill chunks
+        # while later chunks compute, final window from a background
+        # thread so decode admission beats the last chunk. Off (the
+        # default) keeps the PR-14 serialized shape byte-identical.
+        self._disagg_pipeline = bool(disagg_pipeline)
+        # Constructed before the transfer mover so it can count device
+        # fallbacks (FleetOps is self-contained — no fleet back-refs).
+        self.ops = FleetOps()
         self._disagg_transfer = None
         if self.disagg:
             from generativeaiexamples_tpu.serving.disagg import (
                 KVPageTransfer)
 
             self._disagg_transfer = KVPageTransfer(
-                timeout_s=disagg_transfer_timeout_s)
+                timeout_s=disagg_transfer_timeout_s,
+                chunk_pages=disagg_transfer_chunk_pages,
+                device_path=disagg_device_path,
+                ops=self.ops)
         self.router = PrefixLocalityRouter(
             page_size, policy=router_policy, affinity_ttl_s=affinity_ttl_s,
             load_penalty_tokens=load_penalty_tokens,
             shadow_capacity_pages=shadow_capacity_pages)
         self.metrics = FleetMetrics(self)
-        self.ops = FleetOps()
         # Chaos stats (serving/chaos.py) and autoscaler attach here;
         # None keeps the /metrics keys zero-filled and the control
         # paths inert — the static fleet is byte-identical.
@@ -885,19 +1114,25 @@ class EngineFleet:
     # graftlint: hot-path
     def _run_disagg_stages(self, prid: str, drid: str, req) -> bool:
         """Prefill `req`'s prompt on the prefill-role replica `prid`,
-        then ship the finished KV pages to the decode replica `drid`
-        (host-bounce via KVPageTransfer). Returns True when the
-        decode replica holds the prefix afterwards; False means the
-        caller's decode dispatch serves COLOCATED on the same stream
-        (counted in disagg_fallbacks) — disagg never fails a request
-        that colocated serving would have carried."""
+        then ship the KV pages to the decode replica `drid` via
+        KVPageTransfer — serialized after the whole prefill (the
+        PR-14 shape), or overlapped with it when disagg_pipeline is
+        on. Returns True when the decode replica holds (at least a
+        prefix of) the pages afterwards; False means the caller's
+        decode dispatch serves COLOCATED on the same stream (counted
+        in disagg_fallbacks) — disagg never fails a request that
+        colocated serving would have carried."""
         self.ops.note_disagg()
         ok = False
         try:
-            if self._disagg_prefill(prid, req):
+            if self._disagg_pipeline:
+                ok = self._run_disagg_pipelined(prid, drid, req)
+            elif self._disagg_prefill(prid, req):
                 pages, ms = self._disagg_transfer.transfer(
                     self._by_rid[prid], self._by_rid[drid],
-                    list(req.prompt_ids))
+                    list(req.prompt_ids),
+                    page_size=self.router.page_size)
+                self.ops.note_disagg_transfer(ms)
                 # 0 pages without an exception: the source cached
                 # nothing (falls back) — import returning 0 because
                 # the target already holds the prefix was filtered by
@@ -909,6 +1144,106 @@ class EngineFleet:
         if not ok:
             self.ops.note_disagg_fallback()
         return ok
+
+    # graftlint: hot-path
+    def _run_disagg_pipelined(self, prid: str, drid: str, req) -> bool:
+        """Pipelined two-stage run: submit the prefill stage
+        NON-blocking, then poll its stream while publishing the
+        source's completed chunks (publish_kv_pages) and shipping
+        each newly covered window to the decode replica — the
+        transfer rides UNDER the prefill tail (its wall ms feeds the
+        disagg_overlap_ms counter, the numerator of the bench's
+        overlap pct). After the stage finishes, the remainder ships
+        in chunk windows with the FINAL window on a background
+        thread (KVPageTransfer.ship_async) so the caller's decode
+        admission takes its prefix-cache hit before the last chunk
+        lands (disagg_early_admits); import dedup makes the late
+        chunk harmless. True when at least a prefix shipped."""
+        from generativeaiexamples_tpu.serving.engine import GenRequest
+        from generativeaiexamples_tpu.serving.qos import request_tier
+
+        src = self._by_rid[prid]
+        dst = self._by_rid[drid]
+        mover = self._disagg_transfer
+        ids = list(req.prompt_ids)
+        ps = self.router.page_size
+        n_full = len(ids) // ps
+        if n_full <= 0:
+            return False
+        chunk = mover.chunk_pages or n_full
+        stage = GenRequest(
+            prompt_ids=ids, max_new_tokens=1, temperature=0.0,
+            priority=getattr(req, "priority", "standard"),
+            tenant_id=getattr(req, "tenant_id", ""),
+            request_id=(req.request_id + "-prefill"
+                        if getattr(req, "request_id", "") else ""))
+        tier = request_tier(stage)
+        self.router.note_submitted(prid, 1, tier)
+        shipped = 0
+        overlap_ms = transfer_ms = 0.0
+        stage_ok = None
+        try:
+            src.submit(stage)
+            deadline = time.monotonic() + self._disagg_prefill_timeout_s
+            while stage_ok is None:
+                left = deadline - time.monotonic()
+                if left <= 0 or src.state in ("evicted", "parked"):
+                    stage.cancelled = True
+                    return False
+                try:
+                    ev = stage.stream.get(timeout=min(left, 0.05))
+                    if ev.get("finished"):
+                        stage_ok = ev.get("finish_reason") != "error"
+                        continue
+                except queue.Empty:
+                    pass
+                # Publish is cheap when no new chunk completed (one
+                # no-op control op); each newly covered window ships
+                # while the NEXT chunk computes on the source.
+                covered = min(src.publish_kv_pages(ids), n_full)
+                while shipped < covered:
+                    t0 = time.perf_counter()
+                    _, end_tokens = mover.transfer_window(
+                        src, dst, ids, shipped, min(
+                            chunk, covered - shipped))
+                    dt = (time.perf_counter() - t0) * 1e3
+                    transfer_ms += dt
+                    overlap_ms += dt
+                    if end_tokens // ps <= shipped:
+                        break  # nothing exportable yet; next poll
+                    shipped = end_tokens // ps
+            if not stage_ok:
+                stage.cancelled = True
+                return False
+            # Stage done: ship the remainder; all but the last window
+            # synchronously, the last one in the background.
+            while n_full - shipped > chunk:
+                t0 = time.perf_counter()
+                _, end_tokens = mover.transfer_window(src, dst, ids,
+                                                      shipped, chunk)
+                transfer_ms += (time.perf_counter() - t0) * 1e3
+                if end_tokens // ps <= shipped:
+                    break
+                shipped = end_tokens // ps
+            if shipped < n_full:
+                if shipped > 0:
+                    mover.ship_async(src, dst, ids, shipped)
+                    self.ops.note_disagg_early_admit()
+                else:
+                    # Prefill beat the first poll (short prompt):
+                    # degenerate to the serialized shape.
+                    t0 = time.perf_counter()
+                    _, end_tokens = mover.transfer_window(src, dst,
+                                                          ids, 0, 0)
+                    transfer_ms += (time.perf_counter() - t0) * 1e3
+                    shipped = end_tokens // ps
+            return shipped > 0
+        except BaseException:
+            stage.cancelled = True
+            raise
+        finally:
+            self.ops.note_disagg_transfer(transfer_ms, overlap_ms)
+            self.router.note_finished(prid, 1, tier)
 
     # graftlint: hot-path
     def _disagg_prefill(self, prid: str, req) -> bool:
@@ -1002,6 +1337,15 @@ class EngineFleet:
                              "join timeout")
                 self.ops.note_stuck_join()
             self._probe_thread = None
+        # Background tail ships land before their engines stop — a
+        # timed-out drain is counted like any other stuck join (the
+        # tail thread is daemon; a stopped engine runs its control op
+        # inline, so even a late tail cannot wedge).
+        if self._disagg_transfer is not None:
+            if not self._disagg_transfer.drain(timeout_s=30.0):
+                _LOG.warning("KV tail ships still in flight after "
+                             "drain timeout")
+                self.ops.note_stuck_join()
         for r in self.replicas:
             r.stop()
 
@@ -1448,13 +1792,30 @@ def build_fleet(cfg, engines: Optional[List] = None, tokenizer=None,
         disagg=fcfg.disagg,
         disagg_min_prompt_tokens=fcfg.disagg_min_prompt_tokens,
         disagg_prefill_timeout_s=fcfg.disagg_prefill_timeout_s,
-        disagg_transfer_timeout_s=fcfg.disagg_transfer_timeout_s)
+        disagg_transfer_timeout_s=fcfg.disagg_transfer_timeout_s,
+        disagg_pipeline=fcfg.disagg_pipeline,
+        disagg_device_path=fcfg.disagg_device_path,
+        disagg_transfer_chunk_pages=fcfg.disagg_transfer_chunk_pages)
     if fcfg.autoscale:
         from generativeaiexamples_tpu.serving.autoscaler import (
             FleetAutoscaler)
 
+        replica_factory = None
+        if fcfg.autoscale_spawn == "process":
+            # Process-per-replica spawn lane (ROADMAP 3b): each scale-
+            # up launches an engine-server subprocess and joins it as
+            # a ProcessReplica once its /health answers. The child
+            # reads the same APP_CONFIG_FILE / APP_* env this process
+            # runs under (spawn_process_replica inherits os.environ).
+            def replica_factory(rid: str, role: str) -> ProcessReplica:
+                return spawn_process_replica(
+                    rid, role=role,
+                    ready_timeout_s=fcfg.autoscale_spawn_ready_timeout_s,
+                    probe_timeout_s=fcfg.probe_timeout_s)
+
         FleetAutoscaler(
             fleet, engine_factory=engine_factory,
+            replica_factory=replica_factory,
             min_replicas=fcfg.autoscale_min_replicas,
             max_replicas=fcfg.autoscale_max_replicas,
             warm_pool=fcfg.autoscale_warm_pool,

@@ -91,6 +91,23 @@ def _pow2(n: int) -> int:
     return w
 
 
+def gather_spans(n: int, max_batch_pages: int):
+    """Yield (lo, hi) spans covering ``range(n)`` pages so each batched
+    pool_to_pages gather/scatter stays at most the power-of-two-rounded
+    ``max_batch_pages`` wide (0 = one unbounded span). The shared
+    chunking idiom for demotion (PR 11) and the disagg export gather
+    (PR 17): every live width is one of the power-of-two variants
+    warmup() precompiled, and no single dispatch holds the scheduler's
+    control-op slot for a monolithic whole-prefix gather."""
+    if n <= 0:
+        return
+    maxw = _pow2(max(1, n))
+    if max_batch_pages:
+        maxw = min(maxw, _pow2(max_batch_pages))
+    for lo in range(0, n, maxw):
+        yield lo, min(n, lo + maxw)
+
+
 class KVPager:
     """Three-tier page store + the background spill/compaction worker.
 
@@ -206,11 +223,8 @@ class KVPager:
         compaction rewrite holds the spill); the caller destroys
         those, exactly the PR-1 eviction."""
         dropped: List = []
-        maxw = _pow2(max(1, len(nodes)))
-        if self.max_batch_pages:
-            maxw = min(maxw, _pow2(self.max_batch_pages))
-        for lo in range(0, len(nodes), maxw):
-            batch = nodes[lo:lo + maxw]
+        for lo, hi in gather_spans(len(nodes), self.max_batch_pages):
+            batch = nodes[lo:hi]
             w = _pow2(len(batch))
             row = np.zeros((w,), np.int32)  # padding -> sink page 0
             row[:len(batch)] = [n.page for n in batch]

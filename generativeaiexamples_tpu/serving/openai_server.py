@@ -352,18 +352,41 @@ class OpenAIServer:
 
         body = await request.json()
         ids = list(body.get("prompt") or [])
+        # Chunked-window export (disagg pipelining): start_page /
+        # max_pages select a page window of the cached prefix; absent
+        # = the whole prefix (the PR-14 wire, unchanged). publish
+        # first scatters any newly completed pages of an IN-FLIGHT
+        # prefill into the pool/tree so the window can cover them;
+        # probe returns just {"pages": covered} without the payload
+        # (the poll the pipelined fleet loop rides).
+        start_page = int(body.get("start_page") or 0)
+        max_pages = int(body.get("max_pages") or 0)
+        publish = bool(body.get("publish"))
+        probe = bool(body.get("probe"))
         loop = asyncio.get_running_loop()
+
+        def _export():
+            if (publish or probe) and hasattr(eng, "publish_prefill_pages"):
+                covered = eng.run_control_op(
+                    lambda: eng.publish_prefill_pages(ids))
+                if probe:
+                    return ("probe", covered)
+            elif probe:
+                return ("probe", 0)
+            return ("export", eng.run_control_op(
+                lambda: eng.export_prefix_pages(
+                    ids, start_page=start_page, max_pages=max_pages)))
+
         try:
-            out = await loop.run_in_executor(
-                self._executor,
-                lambda: eng.run_control_op(
-                    lambda: eng.export_prefix_pages(ids)))
+            kind, out = await loop.run_in_executor(self._executor, _export)
         except Exception as e:
             _LOG.warning("kv export failed: %s", e)
             return web.json_response(
                 {"error": {"message": str(e),
                            "type": "service_unavailable",
                            "code": "kv_export_failed"}}, status=503)
+        if kind == "probe":
+            return web.json_response({"pages": int(out or 0)})
         if out is None:
             return web.Response(status=204)
         codes, scales, n_tokens = out
@@ -384,13 +407,18 @@ class OpenAIServer:
             deserialize_kv_transfer)
 
         buf = await request.read()
+        # Chunk seat offset (disagg pipelining): the header rides the
+        # binary payload untouched — the GKVT body stays the PR-14
+        # wire format for every chunk.
+        first_page = int(request.headers.get("X-KV-First-Page", "0") or 0)
         loop = asyncio.get_running_loop()
         try:
             ids, codes, scales = deserialize_kv_transfer(buf)
             pages = await loop.run_in_executor(
                 self._executor,
                 lambda: eng.run_control_op(
-                    lambda: eng.import_prefix_pages(ids, codes, scales)))
+                    lambda: eng.import_prefix_pages(
+                        ids, codes, scales, first_page=first_page)))
         except ValueError as e:  # bad payload
             return web.json_response(
                 {"error": {"message": str(e),

@@ -141,6 +141,56 @@ class TestWireFormat:
             with pytest.raises(ValueError):
                 deserialize_kv_transfer(full[:cut])
 
+    def _full_payload(self):
+        cshape, _, sshape = page_geometry(_random_pool("int8"))
+        codes = np.zeros((2,) + cshape, np.int8)
+        scales = np.zeros((2,) + sshape, np.float32)
+        return serialize_kv_transfer([1] * 2 * PS, codes, scales)
+
+    def test_truncated_preamble_names_the_preamble(self):
+        with pytest.raises(ValueError, match="preamble"):
+            deserialize_kv_transfer(b"GKVT1\x10")
+
+    def test_header_overclaiming_length_rejected(self):
+        """A header-length field claiming more bytes than the buffer
+        holds must fail the length check, not read past the end."""
+        import struct as _struct
+
+        buf = b"GKVT1" + _struct.pack("<I", 10_000) + b"{}"
+        with pytest.raises(ValueError, match="header claims"):
+            deserialize_kv_transfer(buf)
+
+    @pytest.mark.parametrize("header", [
+        b"not json at all",            # undecodable
+        b"[1, 2, 3]",                  # wrong JSON type
+        b'{"n_ids": 4}',               # missing fields
+        b'{"n_ids": -1, "codes_dtype": "int8", "codes_shape": [1],'
+        b' "scales_shape": null}',     # negative dimension
+        b'{"n_ids": 1, "codes_dtype": "no_such_dtype",'
+        b' "codes_shape": [1], "scales_shape": null}',  # unknown dtype
+    ])
+    def test_rotten_header_fields_rejected_with_offset(self, header):
+        import struct as _struct
+
+        buf = b"GKVT1" + _struct.pack("<I", len(header)) + header
+        with pytest.raises(ValueError,
+                           match="malformed KV transfer header at offset"):
+            deserialize_kv_transfer(buf)
+
+    def test_short_body_reports_offset_and_section(self):
+        """A body cut mid-codes must name the starved section and the
+        offset — the sender's framing bug should be findable from the
+        one error string."""
+        full = self._full_payload()
+        with pytest.raises(ValueError,
+                           match=r"short KV transfer body: \w+ needs "
+                                 r"\d+ bytes at offset \d+"):
+            deserialize_kv_transfer(full[: len(full) - 100])
+
+    def test_trailing_bytes_rejected(self):
+        with pytest.raises(ValueError, match="trailing"):
+            deserialize_kv_transfer(self._full_payload() + b"\x00\x01")
+
     @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
     def test_pool_to_pages_bytes_pages_to_pool_roundtrip(self, dtype):
         """The full transfer data path across two POOLS: gather pages
@@ -302,6 +352,265 @@ class TestEngineTransfer:
             e1.stop()
             e2.stop()
 
+    def test_export_window_matches_full_export_slice(self, params):
+        """The window contract: export_prefix_pages(start_page,
+        max_pages) returns exactly the full export's page slice, and
+        its n_tokens covers the prefix THROUGH the window's end."""
+        prompt = [(11 * j) % 250 + 1 for j in range(4 * PS)]
+        e1 = make_engine(params).start()
+        try:
+            self._greedy(e1, prompt, max_new=1)
+            full_codes, full_scales, full_n = e1.run_control_op(
+                lambda: e1.export_prefix_pages(prompt))
+            assert full_n == 4 * PS
+            for start, width in ((0, 2), (1, 1), (2, 0), (3, 2)):
+                out = e1.run_control_op(
+                    lambda s=start, w=width: e1.export_prefix_pages(
+                        prompt, start_page=s, max_pages=w))
+                assert out is not None
+                codes, scales, n_tokens = out
+                end = min(4, start + width) if width else 4
+                assert n_tokens == end * PS
+                np.testing.assert_array_equal(
+                    np.asarray(codes), np.asarray(full_codes[start:end]))
+                if full_scales is not None:
+                    np.testing.assert_array_equal(
+                        np.asarray(scales),
+                        np.asarray(full_scales[start:end]))
+            # A window past the cached prefix is empty, not an error.
+            assert e1.run_control_op(
+                lambda: e1.export_prefix_pages(prompt, start_page=4,
+                                               max_pages=2)) is None
+        finally:
+            e1.stop()
+
+    def test_chunked_import_equals_one_shot(self, params):
+        """Two first_page-offset chunk imports seat the same prefix as
+        one monolithic import — same cached pages, byte-identical
+        stream — and a chunk GAP raises instead of corrupting."""
+        prompt = [(13 * j) % 250 + 1 for j in range(4 * PS)]
+        e1 = make_engine(params).start()
+        one = make_engine(params).start()
+        two = make_engine(params).start()
+        try:
+            self._greedy(e1, prompt, max_new=1)
+            codes, scales, _ = e1.run_control_op(
+                lambda: e1.export_prefix_pages(prompt))
+            sl = (lambda a, lo, hi: None if a is None else a[lo:hi])
+            n_one = one.run_control_op(
+                lambda: one.import_prefix_pages(prompt, codes, scales))
+            n_a = two.run_control_op(
+                lambda: two.import_prefix_pages(
+                    prompt[: 2 * PS], codes[:2], sl(scales, 0, 2)))
+            n_b = two.run_control_op(
+                lambda: two.import_prefix_pages(
+                    prompt, codes[2:], sl(scales, 2, 4), first_page=2))
+            assert (n_a, n_b) == (2, 2)
+            assert n_one == 4
+            assert two.prefix_cache.n_cached_pages \
+                == one.prefix_cache.n_cached_pages == 4
+            assert two.metrics.kv_transfer_chunks == 2
+            assert self._greedy(two, prompt) == self._greedy(one, prompt)
+            # Gap: seating pages [3..) while only [0..1) is resident.
+            three = make_engine(params).start()
+            try:
+                three.run_control_op(
+                    lambda: three.import_prefix_pages(
+                        prompt[:PS], codes[:1], sl(scales, 0, 1)))
+                with pytest.raises(ValueError, match="gap"):
+                    three.run_control_op(
+                        lambda: three.import_prefix_pages(
+                            prompt, codes[3:], sl(scales, 3, 4),
+                            first_page=3))
+            finally:
+                three.stop()
+        finally:
+            e1.stop()
+            one.stop()
+            two.stop()
+
+    @pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+    def test_device_path_bit_identical_to_host_bounce(self, kv_dtype):
+        """The acceptance pin: the device route and the GKVT host
+        bounce seat bit-identical pool bytes (re-exporting from each
+        target compares codes AND scales), and the device route's
+        stream equals the colocated one."""
+        from generativeaiexamples_tpu.serving.fleet import LocalReplica
+
+        p = llama.init_params(TINY, jax.random.PRNGKey(0))
+        prompt = [(7 * j) % 250 + 1 for j in range(3 * PS)]
+        src = make_engine(p, kv_dtype=kv_dtype).start()
+        via_dev = make_engine(p, kv_dtype=kv_dtype).start()
+        via_host = make_engine(p, kv_dtype=kv_dtype).start()
+        try:
+            want = self._greedy(src, prompt)
+            a = LocalReplica("a", src)
+            dev_pages, _ = KVPageTransfer(device_path=True).transfer(
+                a, LocalReplica("b", via_dev), prompt)
+            host_pages, _ = KVPageTransfer().transfer(
+                a, LocalReplica("c", via_host), prompt)
+            assert dev_pages == host_pages == 3
+            assert via_dev.metrics.kv_transfer_device_pages == 3
+            assert via_host.metrics.kv_transfer_device_pages == 0
+            dc, ds, _ = via_dev.run_control_op(
+                lambda: via_dev.export_prefix_pages(prompt))
+            hc, hs, _ = via_host.run_control_op(
+                lambda: via_host.export_prefix_pages(prompt))
+            np.testing.assert_array_equal(np.asarray(dc), np.asarray(hc))
+            if ds is not None:
+                np.testing.assert_array_equal(np.asarray(ds),
+                                              np.asarray(hs))
+            assert self._greedy(via_dev, prompt) == want
+        finally:
+            src.stop()
+            via_dev.stop()
+            via_host.stop()
+
+    def test_publish_prefill_pages_coverage(self, params):
+        """publish_prefill_pages reports (and makes transferable) the
+        covered full-page prefix: 0 for an unknown prompt, the full
+        page count once the prompt is cached, and monotone non-
+        decreasing values when polled against a live engine."""
+        prompt = [(17 * j) % 250 + 1 for j in range(10 * PS)]
+        eng = make_engine(params).start()
+        try:
+            assert eng.run_control_op(
+                lambda: eng.publish_prefill_pages(prompt)) == 0
+            seen = []
+            req_stream = eng.generate_stream(list(prompt),
+                                             max_new_tokens=4)
+            for ev in req_stream:
+                seen.append(eng.run_control_op(
+                    lambda: eng.publish_prefill_pages(prompt)))
+            assert seen == sorted(seen)  # coverage only grows
+            assert eng.run_control_op(
+                lambda: eng.publish_prefill_pages(prompt)) == 10
+            # The published prefix is really in the tree: a repeat
+            # serve takes the prefix hit.
+            before = eng.metrics.prefix_hits
+            self._greedy(eng, prompt, max_new=2)
+            assert eng.metrics.prefix_hits == before + 1
+        finally:
+            eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipelined fleet + process replica lifecycle
+# ---------------------------------------------------------------------------
+
+class TestPipelinedFleet:
+    def _fleet_greedy(self, fleet, prompt, max_new=12):
+        from generativeaiexamples_tpu.serving.engine import GenRequest
+
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=max_new)
+        fleet.submit(req)
+        toks = []
+        while True:
+            ev = req.stream.get(timeout=180)
+            if ev["token_id"] >= 0:
+                toks.append(ev["token_id"])
+            if ev["finished"]:
+                return toks
+
+    def test_pipelined_disagg_byte_identical_and_chunked(self, params):
+        """The tentpole e2e: a pipelined 1-page-chunk disagg fleet
+        serves byte-identically to a colocated engine, the transfer
+        really was windowed (chunks > plans), and decode admission
+        beat the final chunk (early admits counted)."""
+        from generativeaiexamples_tpu.serving.fleet import (
+            EngineFleet, LocalReplica)
+
+        prompts = [[(7 * i + j) % 250 + 1 for j in range(3 * PS + 2 * i)]
+                   for i in range(3)]
+        ref = make_engine(params).start()
+        want = [self._greedy_single(ref, p) for p in prompts]
+        ref.stop()
+        reps = [LocalReplica("r0", make_engine(params), role="prefill"),
+                LocalReplica("r1", make_engine(params), role="decode")]
+        fleet = EngineFleet(reps, ByteTokenizer(), PS, disagg=True,
+                            disagg_pipeline=True,
+                            disagg_transfer_chunk_pages=1).start()
+        try:
+            got = [self._fleet_greedy(fleet, p) for p in prompts]
+            snap = fleet.metrics.snapshot()
+            assert got == want
+            assert snap["router_disagg_plans"] == len(prompts)
+            assert snap["kv_transfer_chunks"] \
+                > snap["router_disagg_plans"]
+            assert snap["disagg_early_admits"] > 0
+            assert snap["disagg_fallbacks"] == 0
+            assert snap["disagg_transfer_ms"] > 0
+        finally:
+            fleet.stop()
+
+    def _greedy_single(self, eng, prompt, max_new=12):
+        return [ev["token_id"] for ev in
+                eng.generate_stream(list(prompt), max_new_tokens=max_new)
+                if ev["token_id"] >= 0]
+
+    def test_pipeline_off_is_serialized_plan(self, params):
+        """disagg_pipeline=False (the default) never chunks and never
+        early-admits — the PR-14 serialized plan, pinned so the
+        default stays byte-identical in behavior AND counters."""
+        from generativeaiexamples_tpu.serving.fleet import (
+            EngineFleet, LocalReplica)
+
+        prompt = [(5 * j) % 250 + 1 for j in range(3 * PS)]
+        reps = [LocalReplica("r0", make_engine(params), role="prefill"),
+                LocalReplica("r1", make_engine(params), role="decode")]
+        fleet = EngineFleet(reps, ByteTokenizer(), PS,
+                            disagg=True).start()
+        try:
+            self._fleet_greedy(fleet, prompt)
+            snap = fleet.metrics.snapshot()
+            assert snap["router_disagg_plans"] == 1
+            assert snap["disagg_early_admits"] == 0
+            assert snap["kv_transfer_chunks"] == 1  # one window
+        finally:
+            fleet.stop()
+
+    def test_ship_async_drain(self):
+        """drain() waits for background tail ships; a failing tail is
+        logged, counted down, and never raises into the caller."""
+        class _SlowSrc:
+            rid = "s"
+
+            def export_kv_pages(self, ids, timeout_s=0, start_page=0,
+                                max_pages=0):
+                import time as _t
+
+                _t.sleep(0.05)
+                return None  # nothing cached: window empty
+
+        class _Dst:
+            rid = "d"
+
+        mover = KVPageTransfer()
+        mover.ship_async(_SlowSrc(), _Dst(), [1, 2, 3], 0)
+        assert mover.drain(timeout_s=10.0)
+        assert mover._inflight == 0
+
+    def test_process_replica_stop_terminates_subprocess(self):
+        import subprocess
+        import sys as _sys
+
+        from generativeaiexamples_tpu.serving.fleet import ProcessReplica
+
+        proc = subprocess.Popen(
+            [_sys.executable, "-c", "import time; time.sleep(600)"])
+        rep = ProcessReplica("p0", "http://127.0.0.1:1", proc,
+                             probe_timeout_s=0.1)
+        try:
+            assert proc.poll() is None
+            rep.stop()
+            assert proc.poll() is not None
+            rep.stop()  # idempotent
+            # A dead process fails healthy() without an HTTP probe.
+            assert not rep.healthy()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
 
 # ---------------------------------------------------------------------------
 # graftlint hot-path coverage of the transfer path
@@ -352,8 +661,13 @@ class TestLintCoverage:
             "serving")
         want = {"router.py": {"place_disagg"},
                 "fleet.py": {"_submit_disagg", "_run_disagg_stages",
-                             "export_kv_pages", "import_kv_pages"},
-                "disagg.py": {"transfer"}}
+                             "_run_disagg_pipelined",
+                             "export_kv_pages", "import_kv_pages",
+                             "publish_kv_pages",
+                             "export_kv_pages_device",
+                             "import_kv_pages_device"},
+                "disagg.py": {"transfer", "transfer_window",
+                              "_ship_tail"}}
         for fname, fns in want.items():
             path = os.path.join(base, fname)
             with open(path) as fh:
@@ -370,3 +684,42 @@ class TestLintCoverage:
                     continue  # e.g. _submit_disagg folded elsewhere
                 assert declared_hot(sf, found[fn]), \
                     f"{fname}:{fn} lost its hot-path marker"
+
+    def test_gl202_covers_transfer_state_lock(self, tmp_path):
+        """GL202 watches the mover's thread model: a seeded sibling of
+        KVPageTransfer whose background-thread write to shared state
+        is locked but whose public read is NOT gets flagged, and the
+        shipped module itself stays GL202-quiet (every access of the
+        pair memo / in-flight count takes self._lock)."""
+        from generativeaiexamples_tpu.lint import lint_paths
+
+        src_path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "generativeaiexamples_tpu",
+            "serving", "disagg.py")
+        with open(src_path) as fh:
+            src = fh.read()
+        bad = src + textwrap.dedent("""
+
+        class _SeededRacyMover:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.shipped = 0
+
+            def start(self):
+                threading.Thread(target=self._pump).start()
+
+            def _pump(self):
+                with self._lock:
+                    self.shipped += 1
+
+            def progress(self):
+                return self.shipped  # unlocked cross-thread read
+        """)
+        mod = tmp_path / "disagg.py"
+        mod.write_text(bad)
+        findings = [f for f in lint_paths([str(mod)])
+                    if f.check == "GL202" and "shipped" in f.message]
+        assert findings, "seeded unlocked cross-thread read not flagged"
+        # ...and the shipped transfer module's lock discipline holds.
+        assert not [f for f in lint_paths([src_path])
+                    if f.check == "GL202"]
