@@ -207,6 +207,16 @@ class MeshConfig:
     dcn_pipeline: int = 1  # cross-host pipeline parallel
     # Axis names are fixed by parallel.mesh.MESH_AXIS_NAMES (pipeline, data,
     # fsdp, expert, sequence, tensor) — not configurable.
+    # Multi-process bring-up (jax.distributed). Empty/defaults = single
+    # process (byte-identical to the pre-multihost engine). When
+    # coordinator_address is set, every process must pass the same value
+    # plus its own process_id in [0, num_processes); the env vars
+    # JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID (or
+    # the --coordinator/--num-processes/--process-id serve flags)
+    # override these fields.
+    coordinator_address: str = ""  # "host:port" of process 0
+    num_processes: int = 0  # 0 = single process / let JAX infer
+    process_id: int = -1  # -1 = single process / let JAX infer
 
 
 @dataclass(frozen=True)
@@ -389,6 +399,29 @@ class EngineConfig:
     flight_ring_size: int = 4096
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
+    # Multi-host serving (jax.distributed over DCN): rank 0 runs the
+    # scheduler + OpenAI surface, follower ranks replay its device
+    # dispatches so cross-process collectives pair up by launch order
+    # (serving/multihost.py). Requires the restricted multihost profile
+    # (no speculation / fused prefill / prefix cache / kv pager —
+    # validated with actionable errors at build). Off = byte-identical
+    # single-process engine.
+    multihost: bool = False
+    # Size the paged-KV pool from serving/memory_plan.py instead of the
+    # max_batch_size*max_pages worst case: the planner accounts sharded
+    # weights + scratch + warmup transients + headroom against per-
+    # device HBM and allocates every remaining byte as KV pages (or
+    # fails fast with the per-host breakdown and the smallest mesh that
+    # would fit). Off = legacy sizing, byte-identical.
+    auto_pool_pages: bool = False
+    # Per-device HBM budget in GiB for the memory planner. 0 = probe
+    # the backend (TPU memory_stats; a 4 GiB default on the CPU/test
+    # backend where there is no real HBM limit).
+    hbm_gb_per_device: float = 0.0
+    # Fraction of per-device HBM the planner refuses to allocate
+    # (compiler scratch, fragmentation, XLA temporaries beyond the
+    # modeled warmup transients). Exposed as planner_headroom_bytes.
+    planner_headroom_fraction: float = 0.1
 
 
 @dataclass(frozen=True)

@@ -189,26 +189,6 @@ def llama_config_from_hf(path: str) -> llama_lib.LlamaConfig:
     )
 
 
-def shard_numpy_tree(tree, spec_tree, mesh, dtype):
-    """Per-leaf host->mesh transfer: each numpy leaf goes straight to its
-    PartitionSpec placement, so no single device ever holds a full tensor
-    (host arrays stay mmap-backed via safetensors). bf16 conversion uses
-    ml_dtypes on host to halve the transfer size."""
-    import ml_dtypes
-    from jax.sharding import NamedSharding
-
-    np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16}.get(dtype, dtype)
-
-    def put(a, spec):
-        a = np.asarray(a).astype(np_dtype)
-        return jax.device_put(a, NamedSharding(mesh, spec))
-
-    return jax.tree.map(
-        put, tree, spec_tree,
-        is_leaf=lambda x: isinstance(x, (np.ndarray, jnp.ndarray)),
-    )
-
-
 def bert_config_from_hf(path: str, n_labels: int = 0) -> bert_lib.BertConfig:
     with open(os.path.join(path, "config.json")) as fh:
         c = json.load(fh)
@@ -263,54 +243,293 @@ def quantize_llama_numpy_tree(tree: dict) -> dict:
     return out
 
 
-def load_llama(path: str, cfg: Optional[llama_lib.LlamaConfig] = None,
-               mesh=None, dtype=None, quantize: bool = False):
-    """Load an HF llama snapshot; if `mesh` is given, each leaf is placed
-    with the model's TP/FSDP PartitionSpec as it is read — required for
-    models larger than one device's HBM (llama3-70b on v5e). With
-    `quantize`, weights are int8-quantized on host BEFORE transfer, so
-    peak per-chip HBM never exceeds the quantized footprint."""
-    import ml_dtypes
+# ---------------------------------------------------------------------------
+# Layer-streaming llama load
+# ---------------------------------------------------------------------------
+# The old path materialized the FULL numpy tree on host before any
+# device_put — ~140 GB of host RAM for llama3-70b bf16, per worker. The
+# streaming path reads one leaf-layer at a time straight to its
+# NamedSharding placement: host peak = one layer tensor, and under a
+# multi-process mesh each host reads only its shard slices from the
+# safetensors files (row/column ranges via get_slice) wherever the
+# quantization scale allows — leaves whose CONTRACTED axis is sharded
+# (wo, w_down under TP; any leaf under FSDP) need the full layer on host
+# once so the per-output-channel amax matches the unsharded reference
+# exactly.
+
+import logging
+
+_LOG = logging.getLogger(__name__)
+
+# leaf -> (HF name format, transpose). HF linears are [out, in]; ours
+# [in, out], so a transposed leaf's target axes map to swapped source
+# axes when slicing.
+_LLAMA_LAYER_LEAVES = {
+    "ln1": ("model.layers.{}.input_layernorm.weight", False),
+    "ln2": ("model.layers.{}.post_attention_layernorm.weight", False),
+    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+    "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+}
+
+
+class _SnapshotReader:
+    """Random access over an HF safetensors snapshot: tensor-name ->
+    file handle indexed once; reads can be sliced (only the requested
+    row/column ranges touch disk) — the primitive that lets each host
+    pull just its shard."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".safetensors"))
+        if not files:
+            raise FileNotFoundError(f"no .safetensors files under {path}")
+        self._handles = [safe_open(f, framework="numpy") for f in files]
+        self._where: Dict[str, Any] = {}
+        for h in self._handles:
+            for name in h.keys():
+                self._where[name] = h
+
+    def shape(self, name: str, transpose: bool) -> tuple:
+        s = tuple(self._where[name].get_slice(name).get_shape())
+        return tuple(reversed(s)) if transpose else s
+
+    def read(self, name: str, transpose: bool, index=None) -> np.ndarray:
+        """Read `name`, optionally only the TARGET-coordinate `index`
+        (tuple of slices); transposed leaves swap the slices into
+        source coordinates so the disk read itself is partial."""
+        h = self._where[name]
+        if index is None:
+            a = h.get_tensor(name)
+        else:
+            src = tuple(reversed(index)) if transpose else tuple(index)
+            a = h.get_slice(name)[src]
+        return a.T if transpose else a
+
+
+def _slice_shape(shape, index) -> tuple:
+    out = []
+    for dim, s in zip(shape, index):
+        lo = s.start or 0
+        hi = dim if s.stop is None else s.stop
+        out.append(hi - lo)
+    return tuple(out)
+
+
+def _is_full(s: slice, dim: int) -> bool:
+    return (s.start or 0) == 0 and (s.stop is None or s.stop >= dim)
+
+
+def _unique_shards(sharding, shape):
+    """Addressable shards grouped by identical index (replication):
+    [(index, [devices])] — each distinct slice is read/built once."""
+    groups: Dict[tuple, list] = {}
+    index_of: Dict[tuple, tuple] = {}
+    for d, idx in sharding.addressable_devices_indices_map(shape).items():
+        key = tuple((s.start, s.stop, s.step) for s in idx)
+        groups.setdefault(key, []).append(d)
+        index_of[key] = idx
+    return [(index_of[k], devs) for k, devs in groups.items()]
+
+
+def _assemble(shape, sharding, np_dtype, fill):
+    """Build one (possibly sharded) jax.Array from host shard buffers.
+    `fill(buf, index)` populates the buffer for one shard; with no
+    sharding the single full buffer lands on the default device."""
+    if sharding is None:
+        buf = np.empty(shape, np_dtype)
+        fill(buf, tuple(slice(None) for _ in shape))
+        return jnp.asarray(buf)
+    arrays = []
+    for idx, devs in _unique_shards(sharding, shape):
+        buf = np.empty(_slice_shape(shape, idx), np_dtype)
+        fill(buf, idx)
+        arrays.extend(jax.device_put(buf, d) for d in devs)
+    return jax.make_array_from_single_device_arrays(shape, sharding, arrays)
+
+
+def _stream_plain(reader, names, transpose, shape, sharding, np_dtype,
+                  stacked):
+    """Plain leaf (no quantization): slice-read each shard directly.
+    `names` is one HF tensor name per layer (or a single name for flat
+    leaves, where `shape` has no leading layer axis)."""
+
+    def fill(buf, idx):
+        if not stacked:
+            buf[...] = reader.read(names[0], transpose,
+                                   index=idx).astype(np_dtype)
+            return
+        for j, l in enumerate(range(idx[0].start or 0,
+                                    shape[0] if idx[0].stop is None
+                                    else idx[0].stop)):
+            buf[j] = reader.read(names[l], transpose,
+                                 index=idx[1:]).astype(np_dtype)
+
+    return _assemble(shape, sharding, np_dtype, fill)
+
+
+def _stream_quant(reader, names, transpose, shape, q_sharding, s_sharding,
+                  stacked):
+    """Int8 leaf: per-layer read -> quantize -> place q (int8) and s
+    (f32 per-output-channel scales) shards.
+
+    When the contracted axis (-2) is fully local per shard, reads are
+    sliced to the shard's output columns and quantized locally — the
+    amax runs over the same full contraction axis, so scales are
+    bit-identical to the unsharded reference. A SHARDED contract axis
+    (wo/w_down under TP, anything under FSDP) forces one full-layer
+    read so the scales stay correct; the slice happens after quantize.
+    """
     from generativeaiexamples_tpu.ops.quant import QuantizedTensor
 
+    L = shape[0] if stacked else 1
+    s_shape = shape[:-2] + shape[-1:]
+
+    def shards(sharding, shp):
+        if sharding is None:
+            return [(tuple(slice(None) for _ in shp), [None])]
+        return _unique_shards(sharding, shp)
+
+    q_shards = [(idx, devs, np.empty(_slice_shape(shape, idx), np.int8))
+                for idx, devs in shards(q_sharding, shape)]
+    s_shards = [(idx, devs, np.empty(_slice_shape(s_shape, idx), np.float32))
+                for idx, devs in shards(s_sharding, s_shape)]
+    need_full = any(not _is_full(idx[-2], shape[-2])
+                    for idx, _, _ in q_shards)
+
+    for l in range(L):
+        name = names[l if stacked else 0]
+        cache: Dict[tuple, QuantizedTensor] = {}
+
+        def qt_for(out_slice):
+            key = (out_slice.start, out_slice.stop)
+            if key not in cache:
+                if need_full:
+                    cache[key] = _quantize_numpy_leaf(
+                        reader.read(name, transpose))
+                else:
+                    cache[key] = _quantize_numpy_leaf(reader.read(
+                        name, transpose, index=(slice(None), out_slice)))
+            return cache[key]
+
+        for idx, _, buf in q_shards:
+            li = idx[1:] if stacked else idx
+            qt = qt_for(slice(None) if need_full else li[-1])
+            part = qt.q[li] if need_full else qt.q
+            if stacked:
+                buf[l] = part
+            else:
+                buf[...] = part
+        for idx, _, buf in s_shards:
+            li = idx[1:] if stacked else idx
+            qt = qt_for(slice(None) if need_full else li[-1])
+            part = qt.s[li] if need_full else qt.s
+            if stacked:
+                buf[l] = part
+            else:
+                buf[...] = part
+
+    def place(shp, shardlist, sharding):
+        if sharding is None:
+            (_, _, buf), = shardlist
+            return jnp.asarray(buf)
+        arrays = []
+        for idx, devs, buf in shardlist:
+            arrays.extend(jax.device_put(buf, d) for d in devs)
+        return jax.make_array_from_single_device_arrays(shp, sharding,
+                                                        arrays)
+
+    return QuantizedTensor(place(shape, q_shards, q_sharding),
+                           place(s_shape, s_shards, s_sharding))
+
+
+def stream_load_llama(path: str, cfg: llama_lib.LlamaConfig, mesh=None,
+                      dtype=None, quantize: bool = False,
+                      progress: Optional[Callable[[str, int, int], None]]
+                      = None) -> Dict[str, Any]:
+    """Layer-streaming HF llama load: leaf by leaf, layer by layer,
+    straight to NamedSharding placement. Values are bit-identical to
+    the old materialize-then-put path (pinned by
+    tests/test_checkpoint_e2e.py); host peak drops from the full tree
+    to one leaf's local shard. `progress(leaf, i, total)` fires after
+    each placed leaf (default: one log line each)."""
+    import ml_dtypes
+    from jax.sharding import NamedSharding
+
+    from generativeaiexamples_tpu.ops.quant import LLAMA_QUANT_KEYS
+
+    dtype = dtype or cfg.dtype
+    np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16}.get(dtype, dtype)
+    reader = _SnapshotReader(path)
+    specs = llama_lib.param_specs(cfg)
+
+    def shardings_for(spec, quantized):
+        if mesh is None:
+            return None, None
+        if not quantized:
+            return NamedSharding(mesh, spec), None
+        from generativeaiexamples_tpu.serving.sharding import (
+            _quantized_leaf_spec)
+
+        qs = _quantized_leaf_spec(spec)
+        return NamedSharding(mesh, qs.q), NamedSharding(mesh, qs.s)
+
+    flat = [("tok_emb", ["model.embed_tokens.weight"], False, False, None),
+            ("ln_f", ["model.norm.weight"], False, False, None)]
+    for leaf, (fmt, transpose) in _LLAMA_LAYER_LEAVES.items():
+        names = [fmt.format(i) for i in range(cfg.n_layers)]
+        flat.append((leaf, names, transpose,
+                     quantize and leaf in LLAMA_QUANT_KEYS, ("layers", leaf)))
+    if not cfg.tie_embeddings:
+        flat.append(("lm_head", ["lm_head.weight"], True, quantize, None))
+
+    params: Dict[str, Any] = {"layers": {}}
+    done_bytes = 0
+    for i, (leaf, names, transpose, quantized, where) in enumerate(flat):
+        spec = specs["layers"][leaf] if where else specs[leaf]
+        layer_shape = reader.shape(names[0], transpose)
+        shape = ((cfg.n_layers,) + layer_shape if where else layer_shape)
+        q_sh, s_sh = shardings_for(spec, quantized)
+        if quantized:
+            val = _stream_quant(reader, names, transpose, shape, q_sh, s_sh,
+                                stacked=where is not None)
+            done_bytes += val.q.nbytes + val.s.nbytes
+        else:
+            val = _stream_plain(reader, names, transpose, shape, q_sh,
+                                np_dtype, stacked=where is not None)
+            done_bytes += val.nbytes
+        if where:
+            params["layers"][leaf] = val
+        else:
+            params[leaf] = val
+        if progress is not None:
+            progress(leaf, i + 1, len(flat))
+        else:
+            _LOG.info("stream-load %s: leaf %d/%d (%s, %s global bytes "
+                      "placed so far)", os.path.basename(path.rstrip("/")),
+                      i + 1, len(flat), leaf, f"{done_bytes:,}")
+    return params
+
+
+def load_llama(path: str, cfg: Optional[llama_lib.LlamaConfig] = None,
+               mesh=None, dtype=None, quantize: bool = False,
+               progress=None):
+    """Load an HF llama snapshot via the layer-streaming path; if `mesh`
+    is given, each leaf goes straight to its TP/FSDP PartitionSpec
+    placement as it is read — required for models larger than one
+    device's HBM (llama3-70b on v5e). With `quantize`, weights are
+    int8-quantized on host per layer BEFORE transfer, so neither host
+    RAM nor per-chip HBM ever exceeds one layer + the quantized
+    footprint."""
     cfg = cfg or llama_config_from_hf(path)
     dtype = dtype or cfg.dtype
-    sd = read_safetensors_dir(path)
-    if not quantize:
-        if mesh is not None:
-            tree = _llama_numpy_tree(sd, cfg)
-            params = shard_numpy_tree(tree, llama_lib.param_specs(cfg), mesh,
-                                      dtype)
-        else:
-            params = llama_params_from_state_dict(sd, cfg, dtype=dtype)
-        return params, cfg
-
-    tree = quantize_llama_numpy_tree(_llama_numpy_tree(sd, cfg))
-    np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16}.get(dtype, dtype)
-
-    def put_plain(a):
-        return jnp.asarray(np.asarray(a).astype(np_dtype))
-
-    if mesh is not None:
-        from generativeaiexamples_tpu.serving.sharding import param_shardings
-
-        shardings = param_shardings(tree, cfg, mesh)
-
-        def put(a, sh):
-            if isinstance(a, QuantizedTensor):
-                return QuantizedTensor(jax.device_put(a.q, sh.q),
-                                       jax.device_put(a.s, sh.s))
-            return jax.device_put(np.asarray(a).astype(np_dtype), sh)
-
-        params = jax.tree.map(
-            put, tree, shardings,
-            is_leaf=lambda x: isinstance(x, QuantizedTensor)
-            or isinstance(x, (np.ndarray, jnp.ndarray)))
-    else:
-        params = jax.tree.map(
-            lambda a: (QuantizedTensor(jnp.asarray(a.q), jnp.asarray(a.s))
-                       if isinstance(a, QuantizedTensor) else put_plain(a)),
-            tree,
-            is_leaf=lambda x: isinstance(x, QuantizedTensor)
-            or isinstance(x, (np.ndarray, jnp.ndarray)))
+    params = stream_load_llama(path, cfg, mesh=mesh, dtype=dtype,
+                               quantize=quantize, progress=progress)
     return params, cfg

@@ -63,21 +63,50 @@ def _resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> dict:
     if wildcards:
         if n_devices % fixed:
             raise ValueError(
-                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                f"{n_devices} devices not divisible by fixed axes product "
+                f"{fixed} (requested {sizes}); smallest working geometry: "
+                f"{_nearest_geometry(sizes, n_devices)} — fixed axes must "
+                f"multiply to a divisor of the device count "
+                f"({_divisors(n_devices)})"
             )
         sizes[wildcards[0]] = n_devices // fixed
     elif fixed != n_devices:
         raise ValueError(
-            f"mesh axes product {fixed} != device count {n_devices}; "
-            f"set one axis to -1 to auto-fill"
+            f"mesh axes product {fixed} != device count {n_devices} "
+            f"(requested {sizes}); smallest working geometry: "
+            f"{_nearest_geometry(sizes, n_devices)} — or set one axis "
+            f"to -1 to auto-fill"
         )
     if sizes["data"] % data_fixed_factor:
         raise ValueError(
             f"resolved data axis {sizes['data']} not divisible by the fixed "
             f"data factor {data_fixed_factor} (ici_data={cfg.ici_data}, "
-            f"dcn_data={cfg.dcn_data})"
+            f"dcn_data={cfg.dcn_data}); pick ici_data*dcn_data from the "
+            f"device-count divisors {_divisors(n_devices)}"
         )
     return sizes
+
+
+def _divisors(n: int, cap: int = 12) -> list:
+    ds = [d for d in range(1, n + 1) if n % d == 0]
+    return ds if len(ds) <= cap else ds[:cap] + ["..."]
+
+
+def _nearest_geometry(sizes: dict, n_devices: int) -> dict:
+    """Smallest-perturbation working geometry for an error hint: keep
+    every requested axis clamped to its largest divisor-of-remaining
+    value (walking slowest axis first), park leftover devices on
+    tensor. Always multiplies to exactly n_devices."""
+    out = {}
+    rem = n_devices
+    for name in MESH_AXIS_NAMES:
+        want = sizes.get(name, 1)
+        want = 1 if want == -1 else max(1, want)
+        got = max(d for d in range(1, min(want, rem) + 1) if rem % d == 0)
+        out[name] = got
+        rem //= got
+    out["tensor"] *= rem  # leftover rides the TP axis (serving default)
+    return {k: v for k, v in out.items() if v != 1} or {"tensor": 1}
 
 
 def build_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -205,20 +234,42 @@ def dcn_transfer_available() -> bool:
     return is_multihost()
 
 
-def maybe_initialize_distributed() -> None:
-    """Multi-host init (DCN): no-op unless JAX_COORDINATOR_ADDRESS is set;
-    on pods this wires jax.distributed so device lists span hosts
-    (reference analog: none — NIM hides it; SURVEY.md §5.8). Failures
-    propagate: a silently-uncoordinated host would compute wrong
-    collectives, which is strictly worse than crashing at startup."""
+def maybe_initialize_distributed(cfg: Optional[MeshConfig] = None) -> None:
+    """Multi-host init (DCN): no-op unless a coordinator is named — by
+    the JAX_COORDINATOR_ADDRESS env (which wins, matching how launchers
+    template per-host env) or by `cfg.coordinator_address` /
+    `cfg.num_processes` / `cfg.process_id` (the --coordinator /
+    --num-processes / --process-id serve flags). On pods this wires
+    jax.distributed so device lists span hosts (reference analog: none —
+    NIM hides it; SURVEY.md §5.8). Failures propagate: a silently
+    uncoordinated host would compute wrong collectives, which is
+    strictly worse than crashing at startup."""
     import os
 
-    # Check the env BEFORE touching any jax API: process_count() would
+    # Resolve BEFORE touching any jax API: process_count() would
     # initialize the local backend, after which distributed.initialize()
     # unconditionally raises ("must be called before any JAX calls").
-    if not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    n_str = os.environ.get("JAX_NUM_PROCESSES", "")
+    p_str = os.environ.get("JAX_PROCESS_ID", "")
+    n_proc = int(n_str) if n_str else 0
+    proc_id = int(p_str) if p_str else -1
+    if cfg is not None:
+        coord = coord or cfg.coordinator_address
+        n_proc = n_proc or cfg.num_processes
+        proc_id = proc_id if proc_id >= 0 else cfg.process_id
+    if not coord:
         return
     from jax._src import distributed as _dist
 
-    if _dist.global_state.client is None:  # not yet initialized
-        jax.distributed.initialize()
+    if _dist.global_state.client is not None:  # already initialized
+        return
+    kwargs: dict = {"coordinator_address": coord}
+    # Leave either unset and jax auto-detects from the cluster env
+    # (TPU pod metadata, SLURM, ...); explicit values serve the
+    # CPU-simulation path where there is nothing to detect.
+    if n_proc > 0:
+        kwargs["num_processes"] = n_proc
+    if proc_id >= 0:
+        kwargs["process_id"] = proc_id
+    jax.distributed.initialize(**kwargs)

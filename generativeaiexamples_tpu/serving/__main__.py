@@ -42,6 +42,13 @@ def build_engines(cfg, model_size: str = "tiny"):
     # as processes; each may be TP internally), this process places
     # requests by prefix locality and proxies the SSE streams.
     urls = (cfg.fleet.replica_urls or "").strip()
+    if cfg.engine.multihost and (cfg.fleet.replicas > 1 or urls):
+        raise ValueError(
+            "engine.multihost=true serves ONE engine spanning all hosts "
+            "behind rank 0; it cannot combine with a replica fleet "
+            f"(fleet.replicas={cfg.fleet.replicas}, replica_urls="
+            f"{urls!r}). Run fleets as separate single-slice processes, "
+            "or drop fleet config for multi-host.")
     if urls and cfg.fleet.replicas <= 0:
         from generativeaiexamples_tpu.serving.fleet import build_fleet
 
@@ -51,10 +58,18 @@ def build_engines(cfg, model_size: str = "tiny"):
         logging.info("router-only fleet over %s", urls)
         return fleet, None, None
 
-    maybe_initialize_distributed()
+    maybe_initialize_distributed(cfg.mesh)
+    if jax.process_count() > 1 and not cfg.engine.multihost:
+        raise ValueError(
+            f"jax.distributed spans {jax.process_count()} processes but "
+            "engine.multihost=false — the engine would fail at its first "
+            "cross-process host fetch. Set engine.multihost=true (and see "
+            "serving/multihost.py for the supported profile), or launch "
+            "without a coordinator for single-host serving.")
     # Multi-chip: build the mesh from config (default MeshConfig puts all
     # devices on the tensor axis — TP serving, the NIM INFERENCE_GPU_COUNT
-    # replacement) and shard params + KV pool over it.
+    # replacement; multi-host keeps TP on ICI and spans hosts via the
+    # dcn_* axes) and shard params + KV pool over it.
     mesh = build_mesh(cfg.mesh) if len(jax.devices()) > 1 else None
 
     if cfg.engine.weights_path:
@@ -121,6 +136,15 @@ def build_engines(cfg, model_size: str = "tiny"):
         llm.warmup(sampled=True,
                    long_prompts=os.environ.get("ENGINE_WARMUP_LONG",
                                                "0") == "1")
+    if cfg.engine.multihost and jax.process_index() != 0:
+        # Follower ranks replay rank 0's dispatch records (the
+        # multihost.run_follower loop, driven from main()) — their
+        # scheduler threads never start and encoders never build; rank 0
+        # alone fronts the OpenAI surface. Warmup DID run above: cross-
+        # process collectives pair by launch order, so every rank must
+        # enter the same warmup programs in the same sequence, and
+        # ENGINE_WARMUP must therefore match across ranks.
+        return llm, None, None
     llm.start()
 
     hermetic = not cfg.engine.weights_path
@@ -162,6 +186,16 @@ def main() -> None:
     ap.add_argument("--model-size", default="tiny",
                     choices=("tiny", "1b", "8b", "70b"),
                     help="geometry when engine.weights_path is empty")
+    ap.add_argument("--coordinator", default="",
+                    help="rank-0 address host:port for jax.distributed "
+                         "(multi-host serving; overrides "
+                         "mesh.coordinator_address)")
+    ap.add_argument("--num-processes", type=int, default=0,
+                    help="total jax.distributed processes "
+                         "(overrides mesh.num_processes)")
+    ap.add_argument("--process-id", type=int, default=None,
+                    help="this host's rank, 0..num_processes-1 "
+                         "(overrides mesh.process_id)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
@@ -171,7 +205,28 @@ def main() -> None:
         OpenAIServer, run_server)
 
     cfg = load_config(args.config)
+    if args.coordinator or args.num_processes or args.process_id is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, mesh=dataclasses.replace(
+            cfg.mesh,
+            coordinator_address=(args.coordinator
+                                 or cfg.mesh.coordinator_address),
+            num_processes=args.num_processes or cfg.mesh.num_processes,
+            process_id=(args.process_id if args.process_id is not None
+                        else cfg.mesh.process_id)))
     llm, emb, rr = build_engines(cfg, args.model_size)
+    if cfg.engine.multihost and jax.process_index() != 0:
+        from generativeaiexamples_tpu.serving.multihost import run_follower
+
+        logging.info("rank %d/%d: follower replay loop (rank 0 serves "
+                     "the OpenAI surface)", jax.process_index(),
+                     jax.process_count())
+        try:
+            run_follower(llm)
+        finally:
+            llm.stop()
+        return
     server = OpenAIServer(llm, emb, rr, model_name=cfg.llm.model_name,
                           embed_model_name=cfg.embeddings.model_name,
                           serving_cfg=cfg.serving)

@@ -67,6 +67,9 @@ from generativeaiexamples_tpu.serving import engine_model
 from generativeaiexamples_tpu.serving.kv_cache import (
     PageAllocator, PagePool, SequencePages)
 from generativeaiexamples_tpu.serving import flight as flight_mod
+from generativeaiexamples_tpu.serving.multihost import (
+    fetch_addressable as mh_fetch_addressable,
+    fetch_replicated as mh_fetch_replicated)
 from generativeaiexamples_tpu.serving.flight import (
     EV_ADMIT, EV_ADMIT_RETRY, EV_FIRST_TOKEN, EV_KV_DEMOTE, EV_KV_PROMOTE,
     EV_KV_TRANSFER, EV_PREFILL_CHUNK, EV_PREFILL_DISPATCH, EV_QOS_PAUSE,
@@ -98,10 +101,14 @@ MAX_ADMISSION_RETRIES = 64
 
 def _to_host(blk):
     """Device block -> host numpy; speculative blocks are
-    (targets, counts) tuples."""
+    (targets, counts) tuples. Multi-host safe: sampled-token blocks are
+    fully replicated across processes, and fetch_replicated raises an
+    actionable error naming this seam if a layout change ever breaks
+    that invariant (instead of XLA's transfer guard deep-failing)."""
     if isinstance(blk, tuple):
-        return tuple(np.asarray(b) for b in blk)
-    return np.asarray(blk)
+        return tuple(mh_fetch_replicated(b, "decode-block readback")
+                     for b in blk)
+    return mh_fetch_replicated(blk, "decode-block readback")
 
 
 class PromptTooLongError(ValueError):
@@ -296,6 +303,12 @@ class EngineMetrics:
         # counters below.
         self.plan_variants_compiled = 0
         self.spec_fallback_steps = 0
+        # Multi-host / planner gauges (always present — 0 when off):
+        # process count of the jax.distributed job this engine spans
+        # (0 = single-process build) and the per-device HBM bytes the
+        # memory planner held back as headroom (0 = planner off).
+        self.multihost_processes = 0
+        self.planner_headroom_bytes = 0
         # Prompt tokens actually run through a prefill forward (valid
         # tokens, not bucket padding) — with the prefix cache on, a hit
         # adds only its uncached suffix here.
@@ -434,6 +447,8 @@ class EngineMetrics:
             "prefix_miss": self.prefix_miss,
             "prefix_evictions": self.prefix_evictions,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            "multihost_processes": self.multihost_processes,
+            "planner_headroom_bytes": self.planner_headroom_bytes,
             # Always present — 0, never absent (the PR-5 counter
             # convention): dashboards must not see the speculation
             # gauge appear and disappear with traffic.
@@ -534,6 +549,26 @@ class LLMEngine:
             self._replicated = shd.replicated(self.mesh)
         else:
             self._replicated = None
+        # Multi-host replay runtime (serving/multihost.py): rank 0 runs
+        # the scheduler and publishes each device dispatch as a record;
+        # follower ranks replay them so cross-process collectives pair
+        # up by launch order. Validated FIRST so an unsupported config
+        # fails before any allocation.
+        self._mh_log = None
+        self._mh_leader = True
+        if self.ecfg.multihost:
+            from generativeaiexamples_tpu.serving import multihost as mh
+
+            if jax.process_count() <= 1:
+                raise mh.MultihostError(
+                    "engine.multihost=true but jax.process_count() == 1; "
+                    "initialize jax.distributed (mesh.coordinator_address/"
+                    "num_processes/process_id or JAX_COORDINATOR_ADDRESS) "
+                    "before building the engine, or turn the knob off")
+            mh.validate_multihost_profile(self.ecfg, self.mesh)
+            self._mh_log = mh.DispatchLog()
+            self._mh_leader = jax.process_index() == 0
+        self._mh_stop_sent = False
         if self.ecfg.compile_cache_dir:
             from generativeaiexamples_tpu.utils.platform import (
                 setup_compile_cache)
@@ -556,6 +591,23 @@ class LLMEngine:
             raise ValueError(
                 f"engine.max_seq_len {self.ecfg.max_seq_len} < page_size {ps}")
         self.max_pages = self.ecfg.max_seq_len // ps
+        # Memory-budget planner (serving/memory_plan.py): with
+        # engine.auto_pool_pages the PagePool is sized from the per-
+        # device HBM accounting instead of the worst-case formula below;
+        # a non-fitting plan raises MemoryPlanError here with the full
+        # breakdown. Off (or explicit n_pages) = legacy sizing,
+        # byte-identical.
+        self.memory_plan = None
+        if n_pages is None and self.ecfg.auto_pool_pages:
+            from generativeaiexamples_tpu.serving.memory_plan import (
+                plan_engine_memory)
+
+            self.memory_plan = plan_engine_memory(
+                cfg, self.ecfg, mesh=self.mesh,
+                n_processes=jax.process_count())
+            n_pages = self.memory_plan.pool_pages
+            _LOG.info("auto_pool_pages: %d pages\n%s", n_pages,
+                      self.memory_plan.breakdown())
         if n_pages is None:
             # +1 sequence of slack beyond the steady-state worst case:
             # retired slots' pages free only when their parked in-flight
@@ -630,6 +682,11 @@ class LLMEngine:
         self.slots: List[Optional[_Slot]] = [None] * self.ecfg.max_batch_size
         self.waiting: deque[GenRequest] = deque()
         self.metrics = EngineMetrics()
+        if self.memory_plan is not None:
+            self.metrics.planner_headroom_bytes = (
+                self.memory_plan.headroom_bytes)
+        if self._mh_log is not None:
+            self.metrics.multihost_processes = jax.process_count()
         if self.kv_pager is not None:
             self.metrics.kv_pager_stats = self.kv_pager.stats
         # Flight recorder (serving/flight.py): one beat record per
@@ -1270,6 +1327,18 @@ class LLMEngine:
         return self
 
     def stop(self) -> None:
+        # Leader tells followers to exit their replay loop BEFORE the
+        # scheduler joins: a follower blocked in next_record() would
+        # otherwise wait out its timeout. Exactly-once across repeated
+        # stop() calls (chaos kills race health-probe eviction).
+        if (self._mh_log is not None and self._mh_leader
+                and not self._mh_stop_sent):
+            self._mh_stop_sent = True
+            try:
+                self._mh_log.publish("stop")
+            except Exception:
+                _LOG.warning("multihost: stop record publish failed",
+                             exc_info=True)
         self._running = False
         self._wake.set()
         self._pace_wake.set()
@@ -1340,6 +1409,20 @@ class LLMEngine:
                     f"{max_prompt} (page capacity minus one generated "
                     f"token)")
             req.prompt_ids = req.prompt_ids[-max_prompt:]
+        if self._mh_log is not None:
+            # Chunked long-prefill dispatches (scratch KVCache + scatter)
+            # are not in the multihost replay protocol yet; cap prompts
+            # at the largest bucket so the dispatch stream stays inside
+            # the two replayed record kinds.
+            bucket_cap = max(self.ecfg.prefill_buckets)
+            if len(req.prompt_ids) > bucket_cap:
+                if not req.truncate_prompt:
+                    raise PromptTooLongError(
+                        f"prompt is {len(req.prompt_ids)} tokens; "
+                        f"engine.multihost caps prompts at the largest "
+                        f"prefill bucket ({bucket_cap}) — chunked long "
+                        f"prefills are not replayed across hosts yet")
+                req.prompt_ids = req.prompt_ids[-bucket_cap:]
         with self._lock:
             self.waiting.append(req)
             self._tier_depth(req, +1)
@@ -1466,9 +1549,16 @@ class LLMEngine:
             row[: len(batch)] = [n.page for n in batch]
             got, got_s = engine_model.pool_to_pages(self.pool,
                                                     self._put(row))
-            codes[s_lo:s_hi] = np.asarray(got)[: len(batch)]
+            # Pool pages are sharded on kv-heads (tensor axis): under a
+            # multi-host mesh this host only owns its shard, so the
+            # gather must assemble addressable shards (and fail with
+            # the seam name, never a raw XLA transfer error).
+            codes[s_lo:s_hi] = mh_fetch_addressable(
+                got, "kv-page export gather (pool_to_pages)")[: len(batch)]
             if scales is not None:
-                scales[s_lo:s_hi] = np.asarray(got_s)[: len(batch)]
+                scales[s_lo:s_hi] = mh_fetch_addressable(
+                    got_s, "kv-page export gather (pool_to_pages "
+                    "scales)")[: len(batch)]
         cold_w = cold[max(lo - len(dev), 0): max(hi - len(dev), 0)]
         if cold_w:
             self.kv_pager.read_pages(
@@ -1695,7 +1785,20 @@ class LLMEngine:
         jit never sees an input committed to a single device of a
         multi-device computation."""
         if self._replicated is not None:
-            return jax.device_put(np.asarray(x), self._replicated)
+            x = np.asarray(x)
+            if jax.process_count() > 1:
+                # device_put to a cross-process sharding launches a
+                # broadcast collective (multihost assert_equal) — every
+                # rank would have to mirror every host put in lockstep.
+                # Replicate locally instead: each process already holds
+                # the full value (leader from its scheduler, followers
+                # from the dispatch record), so assembling from
+                # single-device buffers is collective-free.
+                bufs = [jax.device_put(x, d)
+                        for d in self._replicated.addressable_devices]
+                return jax.make_array_from_single_device_arrays(
+                    x.shape, self._replicated, bufs)
+            return jax.device_put(x, self._replicated)
         return jnp.asarray(x)
 
     def _bucket_for(self, n: int) -> int:
@@ -1938,7 +2041,10 @@ class LLMEngine:
             except AttributeError:
                 pass  # non-jax array (tests): treat as ready
             self._pending_first.remove(item)
-            self._emit_first_values(np.asarray(toks).reshape(-1), metas)
+            self._emit_first_values(
+                mh_fetch_replicated(
+                    toks, "prefill first-token readback").reshape(-1),
+                metas)
 
     @property
     def _prefill_cap(self) -> int:
@@ -2286,6 +2392,14 @@ class LLMEngine:
         if self._debug_timing:
             _LOG.info("[timing] prefill bucket=%d n=%d padded=%d",
                       bucket, n, N)
+        if self._mh_log is not None and self._mh_leader:
+            # Publish BEFORE launching: cross-process collectives pair
+            # by launch order, so followers must enter this same jitted
+            # prefill as their very next dispatch.
+            self._mh_log.publish(
+                "prefill", tokens=tokens, lengths=lengths, rows=rows,
+                temps=temps, top_ps=top_ps, top_ks=top_ks, idxs=idxs,
+                flags=np.asarray(flags))
         toks, self.pool = engine_model.prefill_batch_step(
             self.params, self.cfg, self.pool, self._put(tokens),
             self._put(lengths), self._put(rows), self._put(temps),
@@ -2982,6 +3096,15 @@ class LLMEngine:
             and bool(all(temps[i] <= 0.0 for i in active)))
         flags = (True, False, False) if all_greedy else (False, True, True)
         plan, lp = self._select_plan(K, spec_mode)
+        if self._mh_log is not None and self._mh_leader:
+            # Publish BEFORE launching (collectives pair by launch
+            # order). K alone reproduces the plan on the follower: the
+            # multihost profile pins spec_mode off and step_plans off,
+            # so _select_plan(K, False) is a pure function of K.
+            self._mh_log.publish(
+                "decode", k=np.int32(K), tables=tables, lengths=lengths,
+                active_mask=active_mask, temps=temps, top_ps=top_ps,
+                top_ks=top_ks, flags=np.asarray(flags))
         res = self._dispatch_plan(plan, lp, tables, lengths, active_mask,
                                   temps, top_ps, top_ks, flags)
         self.metrics.decode_steps += K
@@ -3132,6 +3255,35 @@ class LLMEngine:
                 self._long_prefills.remove(lp)
                 self._finish_long_prefill(lp, res["chunk_logits"])
         return res
+
+    # -- multihost dispatch replay (serving/multihost.run_follower) --------
+
+    def _replay_prefill(self, rec: Dict[str, np.ndarray]) -> None:
+        """Follower half of _prefill_group's device dispatch: the same
+        engine_model launches, driven by the leader's record — no
+        admission, no slots, no host readback. The RNG stream stays in
+        lockstep because both ranks call _next_key() exactly once per
+        replayed dispatch (and ran an identical warmup)."""
+        flags = tuple(bool(f) for f in rec["flags"])
+        toks, self.pool = engine_model.prefill_batch_step(
+            self.params, self.cfg, self.pool, self._put(rec["tokens"]),
+            self._put(rec["lengths"]), self._put(rec["rows"]),
+            self._put(rec["temps"]), self._put(rec["top_ps"]),
+            self._put(rec["top_ks"]), self._next_key(), self.use_pallas,
+            sampling_flags=flags, mesh=self.mesh)
+        self._last_tokens = engine_model.set_last_tokens(
+            self._last_tokens, self._put(rec["idxs"]), toks)
+
+    def _replay_decode(self, rec: Dict[str, np.ndarray]) -> None:
+        """Follower half of _dispatch_decode's device dispatch: K alone
+        reproduces the StepPlan (the multihost profile pins speculation,
+        step plans and the fused rider off), and _dispatch_plan folds
+        pool/_last_tokens forward exactly as on the leader."""
+        plan, lp = self._select_plan(int(rec["k"]), False)
+        self._dispatch_plan(
+            plan, lp, rec["tables"], rec["lengths"], rec["active_mask"],
+            rec["temps"], rec["top_ps"], rec["top_ks"],
+            tuple(bool(f) for f in rec["flags"]))
 
     def _pick_k(self, bound: int) -> int:
         """Largest dispatchable K <= bound: power-of-two, and (when a
@@ -3321,7 +3473,10 @@ class LLMEngine:
             if not any(s is slot for _, s in metas):
                 continue
             self._pending_first.remove(item)
-            self._emit_first_values(np.asarray(toks).reshape(-1), metas)
+            self._emit_first_values(
+                mh_fetch_replicated(
+                    toks, "prefill first-token readback").reshape(-1),
+                metas)
             return
 
     def _emit_first_values(self, vals: np.ndarray, metas) -> None:

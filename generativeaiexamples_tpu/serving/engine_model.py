@@ -33,6 +33,22 @@ from generativeaiexamples_tpu.serving.paged_attention import (
     paged_attention_dispatch)
 
 
+def _replicate_tokens(mesh, *arrs):
+    """Pin sampled-token outputs to a fully-replicated layout when the
+    mesh spans processes: XLA's sharding propagation otherwise leaves
+    them tensor-sharded, and a multi-host scheduler cannot read a token
+    array whose shards live on remote hosts (multihost.fetch_replicated
+    rejects exactly that). The all-gather this inserts runs INSIDE the
+    dispatched program, so leader and followers launch it in lockstep;
+    token values are integers, so single-process streams are unchanged.
+    Trace-time no-op (returns inputs) for single-process meshes."""
+    if mesh is None or jax.process_count() == 1:
+        return arrs if len(arrs) > 1 else arrs[0]
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    out = tuple(jax.lax.with_sharding_constraint(a, rep) for a in arrs)
+    return out if len(out) > 1 else out[0]
+
+
 def _page_axes(L, KH, table_flat):
     li = jnp.arange(L)[:, None, None]
     kh = jnp.arange(KH)[None, :, None]
@@ -232,7 +248,7 @@ def prefill_batch_step(
     sp = SamplingParams(temperature, top_p, top_k)
     toks = sample(logits, sp, key, all_greedy=all_greedy,
                   any_top_k=any_top_k, any_top_p=any_top_p)
-    return toks, pool
+    return _replicate_tokens(mesh, toks), pool
 
 
 @functools.partial(jax.jit, donate_argnames=("last_tokens",))
@@ -402,7 +418,9 @@ def decode_multi_step(
         tokens = jnp.where(active, nxt, tokens)
         out_tokens.append(tokens)
         lengths = jnp.where(active, lengths + 1, lengths)
-    return jnp.stack(out_tokens, axis=1), tokens, pool
+    block, tokens = _replicate_tokens(
+        mesh, jnp.stack(out_tokens, axis=1), tokens)
+    return block, tokens, pool
 
 
 # -- speculative decode (greedy self-speculation) ------------------------

@@ -231,8 +231,17 @@ class KVPager:
             codes, scales = engine_model.pool_to_pages(pool, self._put(row))
             # Blocking device->host fetch BY DESIGN: the demotion
             # barrier (pages are recycled the moment this returns).
-            fetched = np.asarray(codes)
-            fetched_s = np.asarray(scales) if scales is not None else None
+            # Routed through the multihost seam helper: pool pages are
+            # tensor-sharded, so a cross-process mesh must assemble
+            # addressable shards or fail naming this seam (the
+            # multihost profile disables the pager for now).
+            from generativeaiexamples_tpu.serving.multihost import (
+                fetch_addressable)
+
+            fetched = fetch_addressable(codes, "kv-pager demote gather")
+            fetched_s = (fetch_addressable(
+                scales, "kv-pager demote gather (scales)")
+                if scales is not None else None)
             with self._lock:
                 stored = 0
                 for i, node in enumerate(batch):

@@ -1,0 +1,269 @@
+"""Multi-host serving runtime: addressable-shard seams + dispatch replay.
+
+The reference's multi-GPU serving is one env var handed to TRT-LLM/NIM
+(INFERENCE_GPU_COUNT, deploy/compose/compose.env:17-18 — NCCL hidden
+inside the engine). Multi-HOST is not even that: NIM does not span
+machines. Here a jax.distributed process group serves one engine across
+hosts, with two contracts this module owns:
+
+1. **Addressable-shard fetches.** Under multi-process JAX, `np.asarray`
+   on an array that spans non-addressable (remote-process) devices
+   raises deep inside XLA with no hint which engine seam pulled it.
+   `fetch_replicated` / `fetch_addressable` are the only sanctioned
+   host↔device crossings: they succeed exactly when the fetch is
+   process-local-safe and otherwise raise `MultihostFetchError` naming
+   the seam (token readback, page gather, prefix seeding, ...) and the
+   fix. Single-process behavior is byte-identical to `np.asarray`.
+
+2. **Dispatch replay.** Cross-process collectives pair up by program
+   LAUNCH ORDER, not by tensor names — every process must enter the
+   same jitted computations in the same sequence or the slice deadlocks.
+   Rank 0 runs the real scheduler (admission, QoS, paging, the OpenAI
+   surface) and publishes a compact record of each device dispatch
+   through the coordination-service KV store *before* launching it;
+   follower ranks replay the records against their own (identically
+   placed) params and pool. Scheduling stays host-side on one rank, so
+   no scheduler state ever needs cross-host consensus.
+
+The replay profile is restricted (see `validate_multihost_profile`):
+speculation, fused prefill, prefix cache, KV pager and step plans are
+rejected at build with actionable errors — each would add dispatch
+kinds or host-state divergence; they can be taught to publish records
+later. Long prompts (chunked prefill) are rejected at submit.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_LOG = logging.getLogger(__name__)
+
+# KV-store key prefix for dispatch records. The coordination service
+# retains set keys for the job's lifetime — a serving session publishes
+# O(dispatches) small values; acceptable for the coordinator process,
+# revisit with key_value_delete if it ever isn't.
+_KEY_PREFIX = "gaiemh"
+_BARRIER_TIMEOUT_MS = 600_000
+
+
+class MultihostError(RuntimeError):
+    pass
+
+
+class MultihostFetchError(MultihostError):
+    """A host fetch touched device shards owned by another process."""
+
+
+def is_active() -> bool:
+    return jax.process_count() > 1
+
+
+def coordination_client():
+    """The jax.distributed coordination-service client (KV store +
+    barriers). Raises if jax.distributed was never initialized."""
+    from jax._src import distributed as _dist
+
+    client = _dist.global_state.client
+    if client is None:
+        raise MultihostError(
+            "jax.distributed is not initialized — engine.multihost needs "
+            "mesh.coordinator_address/num_processes/process_id (or the "
+            "JAX_COORDINATOR_ADDRESS env) set on every process")
+    return client
+
+
+def barrier(name: str, timeout_ms: int = _BARRIER_TIMEOUT_MS) -> None:
+    coordination_client().wait_at_barrier(f"{_KEY_PREFIX}_{name}",
+                                          timeout_ms)
+
+
+# ---------------------------------------------------------------------------
+# Addressable-shard fetch seams
+# ---------------------------------------------------------------------------
+
+
+# graftlint: hot-path
+def fetch_replicated(arr, seam: str) -> np.ndarray:
+    """Host fetch for values every process holds in full (sampled
+    tokens, scalar flags): fully-addressable or fully-replicated arrays
+    only. The ONLY legal way to read a whole array off a multi-host
+    engine — anything else raises here, naming the seam, instead of
+    letting XLA fail deep in a transfer guard."""
+    if not isinstance(arr, jax.Array):
+        return np.asarray(arr)
+    if arr.is_fully_addressable or arr.is_fully_replicated:
+        return np.asarray(arr)
+    raise MultihostFetchError(
+        f"seam {seam!r} fetched an array sharded across processes "
+        f"(sharding={arr.sharding}); multi-host engines may only read "
+        f"fully-replicated outputs here. Keep data/fsdp mesh axes at 1 "
+        f"for serving (engine.multihost profile) or route this seam "
+        f"through fetch_addressable for a per-host shard gather.")
+
+
+# graftlint: hot-path
+def fetch_addressable(arr, seam: str) -> np.ndarray:
+    """Host gather that touches ONLY process-local shards: assembles the
+    global value from `addressable_shards` when local shards (plus
+    replication) cover every index — the per-host half of a KV-page
+    export or pager spill. Raises `MultihostFetchError` naming the seam
+    when remote-only shards exist (the caller must then ship per-host
+    slices instead of assuming one host sees everything)."""
+    if not isinstance(arr, jax.Array):
+        return np.asarray(arr)
+    if arr.is_fully_addressable:
+        return np.asarray(arr)
+    local = {}
+    for sh in arr.addressable_shards:
+        local[_index_key(sh.index)] = sh
+    idx_map = arr.sharding.devices_indices_map(arr.shape)
+    missing = [d for d, idx in idx_map.items()
+               if _index_key(idx) not in local]
+    if missing:
+        raise MultihostFetchError(
+            f"seam {seam!r}: {len(missing)} shard(s) of shape {arr.shape} "
+            f"live only on remote processes (e.g. {missing[0]}); this host "
+            f"cannot assemble the full value. Per-host export/spill of "
+            f"local shards is required — the multihost profile disables "
+            f"this path (disagg export, kv_pager) for exactly this reason.")
+    out = np.empty(arr.shape, arr.dtype)
+    for sh in arr.addressable_shards:
+        out[sh.index] = np.asarray(sh.data)
+    return out
+
+
+def _index_key(index) -> Tuple:
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-record transport
+# ---------------------------------------------------------------------------
+
+
+def _encode(kind: str, payload: Dict[str, Any]) -> str:
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in payload.items()})
+    return kind + ":" + base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def _decode(blob: str) -> Tuple[str, Dict[str, np.ndarray]]:
+    kind, _, b64 = blob.partition(":")
+    raw = base64.b64decode(b64.encode("ascii")) if b64 else b""
+    if not raw:
+        return kind, {}
+    with np.load(io.BytesIO(raw)) as z:
+        return kind, {k: z[k] for k in z.files}
+
+
+class DispatchLog:
+    """Ordered dispatch-record stream over the coordination KV store.
+
+    Rank 0 `publish`es; followers `next_record` in the same order. Keys
+    are a monotone sequence so both sides agree on position without any
+    extra coordination; values are npz-in-base64 (the KV store is
+    string-typed)."""
+
+    def __init__(self, client=None):
+        self._client = client if client is not None else coordination_client()
+        self._seq = 0
+
+    def publish(self, kind: str, **payload) -> None:
+        key = f"{_KEY_PREFIX}/{self._seq:09d}"
+        self._client.key_value_set(key, _encode(kind, payload))
+        self._seq += 1
+
+    def next_record(
+        self, timeout_s: Optional[float] = None,
+        poll_s: float = 60.0,
+    ) -> Tuple[str, Dict[str, np.ndarray]]:
+        """Blocking read of the next record. `timeout_s=None` waits
+        forever (idle serving gaps are unbounded), polling in `poll_s`
+        chunks so a dead leader is survivable with a finite timeout."""
+        key = f"{_KEY_PREFIX}/{self._seq:09d}"
+        waited = 0.0
+        while True:
+            chunk = poll_s if timeout_s is None else min(
+                poll_s, max(0.001, timeout_s - waited))
+            try:
+                blob = self._client.blocking_key_value_get(
+                    key, int(chunk * 1000))
+                break
+            except Exception as e:  # deadline — keep waiting
+                if "eadline" not in str(e) and "imeout" not in str(e):
+                    raise
+                waited += chunk
+                if timeout_s is not None and waited >= timeout_s:
+                    raise MultihostError(
+                        f"no dispatch record {key} within {timeout_s}s — "
+                        f"leader gone?") from e
+        self._seq += 1
+        return _decode(blob)
+
+
+# ---------------------------------------------------------------------------
+# Profile validation + follower loop
+# ---------------------------------------------------------------------------
+
+
+def validate_multihost_profile(ecfg, mesh=None) -> None:
+    """Reject engine configs the replay protocol cannot keep in lockstep,
+    each with the reason and the fix — a silently-diverging dispatch
+    sequence deadlocks the slice, which is strictly worse."""
+    bad = []
+    if ecfg.speculative_k:
+        bad.append("speculative_k > 0: draft/verify widths depend on "
+                   "leader-side acceptance state; set speculative_k=0")
+    if ecfg.step_plans:
+        bad.append("step_plans: the plan lattice point is chosen from "
+                   "scheduler state followers don't see; set "
+                   "step_plans=false")
+    if ecfg.fused_prefill:
+        bad.append("fused_prefill: rider chunks are picked from the "
+                   "admission queue; set fused_prefill=false")
+    if ecfg.prefix_cache:
+        bad.append("prefix_cache: cache seeding issues extra device "
+                   "gathers on hits; set prefix_cache=false")
+    if ecfg.kv_pager:
+        bad.append("kv_pager: HBM<->host page moves are per-host state; "
+                   "set kv_pager=false")
+    if mesh is not None:
+        for ax in ("data", "fsdp"):
+            if int(mesh.shape.get(ax, 1)) > 1:
+                bad.append(
+                    f"mesh {ax} axis = {mesh.shape[ax]}: batch-sharded "
+                    f"token outputs are not fully replicated, so rank 0 "
+                    f"cannot read sampled tokens; keep {ax}=1 and put "
+                    f"devices on tensor/sequence")
+    if bad:
+        raise MultihostError(
+            "engine.multihost=true rejects this config:\n  - "
+            + "\n  - ".join(bad))
+
+
+def run_follower(engine, timeout_s: Optional[float] = None) -> None:
+    """Follower main loop: replay the leader's dispatch records until a
+    stop record arrives. Blocks the calling thread (run it as rank>0's
+    main loop — followers serve no HTTP)."""
+    log = engine._mh_log
+    if log is None:
+        raise MultihostError("engine was not built with multihost=true")
+    n = 0
+    while True:
+        kind, payload = log.next_record(timeout_s=timeout_s)
+        if kind == "stop":
+            _LOG.info("follower: stop record after %d dispatches", n)
+            return
+        if kind == "prefill":
+            engine._replay_prefill(payload)
+        elif kind == "decode":
+            engine._replay_decode(payload)
+        else:
+            raise MultihostError(f"unknown dispatch record kind {kind!r}")
+        n += 1

@@ -66,9 +66,20 @@ def validate_tp(cfg: LlamaConfig, mesh: Mesh) -> None:
         ("mlp_dim", cfg.mlp_dim), ("vocab_size", cfg.vocab_size),
     ) if dim % tp}
     if bad:
+        import math
+
+        g = math.gcd(math.gcd(cfg.n_heads, cfg.n_kv_heads),
+                     math.gcd(cfg.mlp_dim, cfg.vocab_size))
+        n_dev = mesh.devices.size
+        best = max(t for t in range(1, g + 1)
+                   if g % t == 0 and n_dev % t == 0)
         raise ValueError(
             f"tensor axis {tp} does not divide model dims {bad}; "
-            f"choose ici_tensor dividing all of heads/kv_heads/mlp/vocab")
+            f"smallest working geometry on {n_dev} device(s): "
+            f"ici_tensor={best}"
+            + (f", ici_data={n_dev // best}" if n_dev // best > 1 else "")
+            + f" (shardable-dim gcd {g}; compatible_mesh() applies this "
+            f"clamp automatically)")
 
 
 def _quantized_leaf_spec(spec: P) -> QuantizedTensor:

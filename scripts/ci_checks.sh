@@ -68,7 +68,13 @@
 #                   token streams byte-identical, timeline JSON loads
 #                   and spans nest, analyzer attribution sums ~100%,
 #                   overhead <= 1% on paired bursts).
-#  13. tier-1 tests — the ROADMAP.md pytest gate.
+#  13. multihost smoke — CPU gate for 2-process jax.distributed
+#                   serving (scripts/smoke_multihost.py: config-driven
+#                   distributed init, follower replay lockstep, streams
+#                   byte-identical to a single-process TP=2 engine,
+#                   planner-sized page pool + live gauges, stop record
+#                   exits the follower cleanly).
+#  14. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -127,6 +133,9 @@ if [ "${1:-}" != "--fast" ]; then
 
     step "flight smoke (JAX_PLATFORMS=cpu scripts/smoke_flight.py)"
     JAX_PLATFORMS=cpu python scripts/smoke_flight.py || fail=1
+
+    step "multihost smoke (JAX_PLATFORMS=cpu scripts/smoke_multihost.py)"
+    JAX_PLATFORMS=cpu python scripts/smoke_multihost.py || fail=1
 
     step "tier-1 tests (JAX_PLATFORMS=cpu pytest -m 'not slow')"
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

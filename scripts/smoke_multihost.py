@@ -1,0 +1,253 @@
+"""Multi-host smoke: 2-process jax.distributed serving on the CPU backend.
+
+Orchestrates three subprocesses to gate the multi-host engine runtime
+(serving/multihost.py + engine.multihost) without a TPU pod:
+
+  ref    — single process, 2 emulated CPU devices
+           (--xla_force_host_platform_device_count=2), TP=2 mesh,
+           engine.multihost=false: the byte-identity reference.
+  rank 0 — jax.distributed leader (1 CPU device), TP=2 mesh spanning
+           both processes, gloo collectives; serves the same greedy
+           prompts through the real scheduler, publishing dispatch
+           records.
+  rank 1 — follower: identical build + warmup, then replays rank 0's
+           records via multihost.run_follower until the stop record.
+
+Gates:
+  (a) distributed init: both ranks see process_count==2 and a 2-device
+      global mesh built over mesh.coordinator_address config (the
+      --coordinator serve-flag path, not env);
+  (b) planner-sized pool: engine.auto_pool_pages=true sizes the page
+      pool to memory_plan.pool_pages, and the planner/multihost gauges
+      (planner_headroom_bytes, multihost_processes) are live;
+  (c) sharded decode byte-identical: every token stream from the
+      2-process engine equals the single-process reference exactly;
+  (d) streaming load: both ranks load the checkpoint through
+      stream_load_llama against the cross-process mesh (each host
+      placing only its addressable shards);
+  (e) clean shutdown: rank 0's stop() publishes the stop record, the
+      follower's replay loop exits, both ranks terminate with code 0.
+
+CI-grade: exits nonzero on any violation, prints one JSON summary.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/smoke_multihost.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PS = 8
+MAX_NEW = 12
+PROMPTS = [[(11 * i + 3 * j) % 250 + 1 for j in range(10 + 5 * i)]
+           for i in range(3)]
+
+
+def engine_config(multihost: bool):
+    from generativeaiexamples_tpu.config.schema import EngineConfig
+
+    return EngineConfig(max_batch_size=2, max_seq_len=128, page_size=PS,
+                        prefill_buckets=(16, 32),
+                        pace_emission_max_streams=0, compile_cache_dir="",
+                        multihost=multihost, auto_pool_pages=True)
+
+
+def build_engine(ckpt: str, mesh, multihost: bool):
+    from generativeaiexamples_tpu.models.hf_loader import (
+        llama_config_from_hf, load_llama)
+    from generativeaiexamples_tpu.serving.engine import LLMEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    lcfg = llama_config_from_hf(ckpt)
+    params, lcfg = load_llama(ckpt, cfg=lcfg, mesh=mesh)
+    eng = LLMEngine(params, lcfg, ByteTokenizer(), engine_config(multihost),
+                    mesh=mesh, use_pallas=False)
+    # Identical warmup on every rank: cross-process collectives pair by
+    # launch order, so the warmup program sequence must match exactly.
+    eng.warmup()
+    return eng
+
+
+def serve_prompts(eng):
+    from generativeaiexamples_tpu.serving.engine import GenRequest
+
+    out = []
+    for p in PROMPTS:
+        req = GenRequest(prompt_ids=list(p), max_new_tokens=MAX_NEW)
+        eng.submit(req)
+        toks = []
+        while True:
+            ev = req.stream.get(timeout=300)
+            if ev["token_id"] >= 0:
+                toks.append(ev["token_id"])
+            if ev["finished"]:
+                break
+        out.append(toks)
+    return out
+
+
+def run_ref(args) -> int:
+    from generativeaiexamples_tpu.config.schema import MeshConfig
+    from generativeaiexamples_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(MeshConfig(ici_tensor=2))
+    eng = build_engine(args.ckpt, mesh, multihost=False).start()
+    toks = serve_prompts(eng)
+    eng.stop()
+    with open(args.out, "w") as f:
+        json.dump({"tokens": toks}, f)
+    return 0
+
+
+def run_rank(args) -> int:
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from generativeaiexamples_tpu.config.schema import MeshConfig
+    from generativeaiexamples_tpu.parallel.mesh import (
+        build_mesh, maybe_initialize_distributed)
+    from generativeaiexamples_tpu.serving import multihost as mh
+
+    # The config-driven init path (the --coordinator serve flags), not
+    # the JAX_COORDINATOR_ADDRESS env path.
+    mcfg = MeshConfig(ici_tensor=2, coordinator_address=args.coordinator,
+                      num_processes=2, process_id=args.process_id)
+    maybe_initialize_distributed(mcfg)
+    assert jax.process_count() == 2, jax.process_count()
+    mesh = build_mesh(mcfg)
+    eng = build_engine(args.ckpt, mesh, multihost=True)
+
+    if args.process_id == 0:
+        eng.start()
+        toks = serve_prompts(eng)
+        snap = eng.metrics.snapshot()
+        result = {
+            "tokens": toks,
+            "process_count": jax.process_count(),
+            "pool_pages": int(eng.pool.n_pages),
+            "plan_pool_pages": int(eng.memory_plan.pool_pages),
+            "multihost_processes": int(snap["multihost_processes"]),
+            "planner_headroom_bytes": int(snap["planner_headroom_bytes"]),
+        }
+        eng.stop()  # publishes the stop record for rank 1
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+    else:
+        mh.run_follower(eng, timeout_s=600)
+        eng.stop()
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=("main", "ref", "rank"),
+                    default="main")
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument("--coordinator", default="")
+    ap.add_argument("--ckpt", default="")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.role == "ref":
+        return run_ref(args)
+    if args.role == "rank":
+        return run_rank(args)
+
+    failures = []
+
+    def gate(name, ok, detail=""):
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}"
+              + (f" ({detail})" if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        from tests.test_checkpoint_e2e import write_tiny_hf_checkpoint
+
+        ckpt = os.path.join(tmp, "ckpt")
+        os.makedirs(ckpt)
+        write_tiny_hf_checkpoint(ckpt)
+
+        # A caller's emulated-device-count flag must not leak into the
+        # children: the ref needs exactly 2 devices in ONE process, the
+        # ranks exactly 1 local device each (2 global via distributed).
+        base_flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                            "", os.environ.get("XLA_FLAGS", "")).strip()
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": base_flags}
+        print("multihost smoke: single-process TP=2 reference ...")
+        ref_out = os.path.join(tmp, "ref.json")
+        ref = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--role", "ref",
+             "--ckpt", ckpt, "--out", ref_out],
+            env={**env,
+                 "XLA_FLAGS": (base_flags +
+                               " --xla_force_host_platform_device_count=2")},
+            timeout=600)
+        gate("reference_ran", ref.returncode == 0,
+             f"exit {ref.returncode}")
+        if ref.returncode != 0:
+            print(json.dumps({"multihost_smoke": "fail",
+                              "failures": failures}))
+            return 1
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord = f"127.0.0.1:{s.getsockname()[1]}"
+        print(f"multihost smoke: 2-process jax.distributed @ {coord} ...")
+        rank_out = os.path.join(tmp, "rank0.json")
+        procs = []
+        for pid in (0, 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--role",
+                 "rank", "--process-id", str(pid), "--coordinator", coord,
+                 "--ckpt", ckpt, "--out", rank_out],
+                env=env))
+        codes = []
+        try:
+            for p in procs:
+                codes.append(p.wait(timeout=600))
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            gate("ranks_exited", False, "timeout — slice deadlocked?")
+            print(json.dumps({"multihost_smoke": "fail",
+                              "failures": failures}))
+            return 1
+        gate("ranks_exited", codes == [0, 0], f"exit codes {codes}")
+
+        want = json.load(open(ref_out))["tokens"]
+        got = json.load(open(rank_out)) if os.path.exists(rank_out) else {}
+        gate("distributed_init", got.get("process_count") == 2)
+        gate("streams_byte_identical", got.get("tokens") == want,
+             f"{sum(len(t) for t in want)} reference tokens")
+        gate("planner_sized_pool",
+             got.get("pool_pages", -1) == got.get("plan_pool_pages", -2)
+             and got.get("pool_pages", 0) > 0,
+             f"{got.get('pool_pages')} pages")
+        gate("gauges_live",
+             got.get("multihost_processes") == 2
+             and got.get("planner_headroom_bytes", 0) > 0,
+             f"headroom {got.get('planner_headroom_bytes')} B")
+
+    print(json.dumps({
+        "multihost_smoke": "pass" if not failures else "fail",
+        "failures": failures,
+        "pool_pages": got.get("pool_pages"),
+        "planner_headroom_bytes": got.get("planner_headroom_bytes"),
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

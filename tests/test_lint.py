@@ -1228,3 +1228,79 @@ class TestKernelHotPathMarkers:
         gl401 = [f for f in lint_paths([bad_root]) if f.check == "GL401"]
         assert len(gl401) == 1, [f.format() for f in gl401]
         assert "block_until_ready" in gl401[0].message
+
+
+class TestMultihostSeamMarkers:
+    """Multi-host pin: the addressable-shard fetch seams in
+    serving/multihost.py (`fetch_replicated`, `fetch_addressable`) are
+    the only sanctioned host readback/gather crossings of a
+    cross-process engine, and each carries a `# graftlint: hot-path`
+    marker the linter actually SEES: a host sync seeded into the real
+    source of either seam fires GL401, and the unseeded copy is quiet
+    (the seams' own `np.asarray(arr)` of a replicated/local value is
+    deliberately outside the device-name heuristic)."""
+
+    CASES = [
+        # fetch_replicated: the replicated-fetch fast path
+        ("serving/multihost.py",
+         "    if arr.is_fully_addressable or arr.is_fully_replicated:\n",
+         "    jax.block_until_ready(arr)\n"),
+        # fetch_addressable: the local-shard assembly path
+        ("serving/multihost.py",
+         "    local = {}\n",
+         "    jax.block_until_ready(arr)\n"),
+    ]
+
+    @pytest.mark.parametrize("case", range(2))
+    def test_seeded_sync_fires_gl401(self, case, tmp_path):
+        rel, anchor, sync = self.CASES[case]
+        src = open(os.path.join(PKG, rel)).read()
+        assert src.count(anchor) == 1, (
+            f"anchor line no longer unique/present in {rel}; update "
+            f"TestMultihostSeamMarkers.CASES")
+        clean_root = write_tree(tmp_path / "clean", {"mod.py": src})
+        gl401 = [f for f in lint_paths([clean_root]) if f.check == "GL401"]
+        assert gl401 == [], [f.format() for f in gl401]
+        seeded = src.replace(anchor, sync + anchor, 1)
+        bad_root = write_tree(tmp_path / "seeded", {"mod.py": seeded})
+        gl401 = [f for f in lint_paths([bad_root]) if f.check == "GL401"]
+        assert len(gl401) == 1, [f.format() for f in gl401]
+        assert "block_until_ready" in gl401[0].message
+
+
+class TestMultihostGaugeSurfacing:
+    """GL601 over the REAL EngineMetrics: the multi-host/planner gauges
+    (`multihost_processes`, `planner_headroom_bytes`) are read by
+    snapshot(), so an increment anywhere in the class stays quiet; if
+    a refactor drops the snapshot rows, the same increment fires GL601
+    naming both gauges — the linter, not just the metrics tests, pins
+    the surfacing contract."""
+
+    SEED = ("    def note_seeded(self):\n"
+            "        self.multihost_processes += 1\n"
+            "        self.planner_headroom_bytes += 1\n\n"
+            "    def snapshot(self)")
+
+    def _engine_src(self):
+        src = open(os.path.join(PKG, "serving", "engine.py")).read()
+        assert src.count("    def snapshot(self)") == 1
+        return src.replace("    def snapshot(self)", self.SEED, 1)
+
+    def test_surfaced_gauges_stay_quiet(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": self._engine_src()})
+        gl601 = [f for f in lint_paths([root]) if f.check == "GL601"]
+        assert gl601 == [], [f.format() for f in gl601]
+
+    def test_dropping_snapshot_rows_fires(self, tmp_path):
+        src = self._engine_src()
+        for row in ('            "multihost_processes": '
+                    'self.multihost_processes,\n',
+                    '            "planner_headroom_bytes": '
+                    'self.planner_headroom_bytes,\n'):
+            assert src.count(row) == 1, row
+            src = src.replace(row, "", 1)
+        root = write_tree(tmp_path, {"engine.py": src})
+        gl601 = [f for f in lint_paths([root]) if f.check == "GL601"]
+        msgs = " ".join(f.message for f in gl601)
+        assert "multihost_processes" in msgs, msgs
+        assert "planner_headroom_bytes" in msgs, msgs

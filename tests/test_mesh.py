@@ -28,6 +28,67 @@ def test_bad_product_raises(eight_devices):
         mesh_lib.build_mesh(MeshConfig(ici_data=-1, ici_tensor=-1))
 
 
+def test_wildcard_double_raises(eight_devices):
+    # Both halves of the combined data axis wild: unresolvable.
+    with pytest.raises(ValueError, match="only one of ici_data/dcn_data"):
+        mesh_lib.build_mesh(MeshConfig(ici_data=-1, dcn_data=-1))
+    # Two wildcards on DIFFERENT axes (tensor defaults to -1).
+    with pytest.raises(ValueError, match="at most one"):
+        mesh_lib.build_mesh(MeshConfig(ici_fsdp=-1))
+
+
+def test_axis_size_zero_raises(eight_devices):
+    with pytest.raises(ValueError, match=">= 1 or -1"):
+        mesh_lib.build_mesh(MeshConfig(ici_tensor=0))
+    with pytest.raises(ValueError, match=">= 1 or -1"):
+        mesh_lib.build_mesh(MeshConfig(ici_data=-2, ici_tensor=1))
+
+
+def test_wildcard_nondividing_fixed_factor(eight_devices):
+    # Wildcard present but the fixed axes' product (3) does not divide
+    # the device count: the error must hand back a geometry that works.
+    with pytest.raises(ValueError, match="smallest working geometry"):
+        mesh_lib.build_mesh(MeshConfig(ici_data=3, ici_tensor=-1))
+    # No wildcard, wrong product: same contract.
+    with pytest.raises(ValueError, match="smallest working geometry"):
+        mesh_lib.build_mesh(MeshConfig(ici_data=3, ici_tensor=5))
+
+
+def test_dcn_wildcard_fixed_factor(eight_devices):
+    # dcn_data wild + fixed ici_data: combined data axis fills to 8 but
+    # must stay divisible by the fixed ici factor.
+    m = mesh_lib.build_mesh(MeshConfig(ici_data=2, dcn_data=-1,
+                                       ici_tensor=2))
+    assert m.shape["data"] == 4 and m.shape["tensor"] == 2
+    with pytest.raises(ValueError, match="data factor"):
+        mesh_lib.build_mesh(MeshConfig(ici_data=3, dcn_data=-1,
+                                       ici_tensor=1))
+
+
+def test_nearest_geometry_hint_content(eight_devices):
+    # The named geometry must itself build: extract it and rebuild.
+    sizes = {"pipeline": 1, "data": 3, "fsdp": 1, "expert": 1,
+             "sequence": 1, "tensor": 5}
+    hint = mesh_lib._nearest_geometry(sizes, 8)
+    import math
+
+    assert math.prod(hint.values()) == 8
+    assert hint == {"data": 2, "tensor": 4}
+
+
+def test_validate_tp_names_working_geometry(eight_devices):
+    from generativeaiexamples_tpu.models.llama import LlamaConfig
+    from generativeaiexamples_tpu.serving import sharding as shd
+
+    # heads gcd-chain = 3: no tensor axis > 1 fits 8 devices, so the
+    # error must point at ici_tensor=1 with the remainder on data.
+    lcfg = LlamaConfig(vocab_size=24, dim=12, n_layers=1, n_heads=6,
+                       n_kv_heads=3, head_dim=2, mlp_dim=12)
+    m = mesh_lib.build_mesh(MeshConfig(ici_tensor=4, ici_data=2))
+    with pytest.raises(ValueError, match=r"ici_tensor=1, ici_data=8"):
+        shd.validate_tp(lcfg, m)
+
+
 def test_logical_to_spec():
     spec = mesh_lib.logical_to_spec(("batch", "seq", "heads", None))
     assert spec == P(("data", "fsdp"), "sequence", "tensor", None)

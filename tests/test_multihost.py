@@ -1,0 +1,246 @@
+"""Multi-host runtime units: fetch seams, dispatch log, replay lockstep.
+
+Single-process tests — the 2-process integration path is gated by
+scripts/smoke_multihost.py; here the contracts are pinned with stub
+clients and spec'd mock arrays (a real cross-process shard cannot exist
+in one pytest process).
+"""
+
+from unittest import mock
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.config.schema import EngineConfig, MeshConfig
+from generativeaiexamples_tpu.serving import multihost as mh
+
+
+# ---------------------------------------------------------------------------
+# fetch seams
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_passthrough_on_plain_and_local_arrays():
+    x = np.arange(6).reshape(2, 3)
+    np.testing.assert_array_equal(mh.fetch_replicated(x, "t"), x)
+    np.testing.assert_array_equal(mh.fetch_addressable(x, "t"), x)
+    j = jax.numpy.arange(4)  # single-process: fully addressable
+    np.testing.assert_array_equal(mh.fetch_replicated(j, "t"), np.arange(4))
+    np.testing.assert_array_equal(mh.fetch_addressable(j, "t"), np.arange(4))
+
+
+def _mock_array(shape, dtype=np.int32, *, replicated, shards, index_map):
+    """A spec'd jax.Array mock: passes isinstance, exposes exactly the
+    attributes the fetch seams read."""
+    arr = mock.MagicMock(spec=jax.Array)
+    arr.shape = shape
+    arr.dtype = np.dtype(dtype)
+    arr.is_fully_addressable = False
+    arr.is_fully_replicated = replicated
+    mocked = []
+    for index, data in shards:
+        sh = mock.Mock()
+        sh.index = index
+        sh.data = data
+        mocked.append(sh)
+    arr.addressable_shards = mocked
+    arr.sharding.devices_indices_map.return_value = index_map
+    return arr
+
+
+def test_fetch_replicated_rejects_cross_process_shards():
+    arr = _mock_array((4,), replicated=False, shards=[], index_map={})
+    with pytest.raises(mh.MultihostFetchError, match="token readback"):
+        mh.fetch_replicated(arr, "token readback")
+
+
+def test_fetch_addressable_assembles_local_coverage():
+    lo, hi = (slice(0, 2, None),), (slice(2, 4, None),)
+    arr = _mock_array(
+        (4,), replicated=False,
+        shards=[(lo, np.array([1, 2], np.int32)),
+                (hi, np.array([3, 4], np.int32))],
+        index_map={"dev0": lo, "dev1": hi})
+    np.testing.assert_array_equal(mh.fetch_addressable(arr, "gather"),
+                                  np.array([1, 2, 3, 4], np.int32))
+
+
+def test_fetch_addressable_names_missing_remote_shards():
+    lo, hi = (slice(0, 2, None),), (slice(2, 4, None),)
+    arr = _mock_array((4,), replicated=False,
+                      shards=[(lo, np.array([1, 2], np.int32))],
+                      index_map={"dev0": lo, "remote-dev": hi})
+    with pytest.raises(mh.MultihostFetchError,
+                       match="page export.*remote processes"):
+        mh.fetch_addressable(arr, "page export")
+
+
+# ---------------------------------------------------------------------------
+# dispatch log
+# ---------------------------------------------------------------------------
+
+
+class _StubClient:
+    """coordination-service KV stand-in: string store + deadline error
+    on missing keys (matching blocking_key_value_get semantics)."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, k, v):
+        self.kv[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        if k not in self.kv:
+            raise RuntimeError("Deadline Exceeded")
+        return self.kv[k]
+
+
+def test_encode_decode_roundtrip():
+    payload = {"tokens": np.arange(12, dtype=np.int32).reshape(3, 4),
+               "temps": np.zeros(3, np.float32),
+               "k": np.int32(7)}
+    kind, out = mh._decode(mh._encode("prefill", payload))
+    assert kind == "prefill"
+    assert set(out) == set(payload)
+    for k in payload:
+        np.testing.assert_array_equal(out[k], payload[k])
+    assert mh._decode(mh._encode("stop", {})) == ("stop", {})
+
+
+def test_dispatch_log_orders_and_times_out():
+    client = _StubClient()
+    pub = mh.DispatchLog(client=client)
+    sub = mh.DispatchLog(client=client)
+    pub.publish("prefill", tokens=np.array([1, 2]))
+    pub.publish("decode", k=np.int32(4))
+    kind0, rec0 = sub.next_record(timeout_s=1)
+    kind1, rec1 = sub.next_record(timeout_s=1)
+    assert kind0 == "prefill" and list(rec0["tokens"]) == [1, 2]
+    assert kind1 == "decode" and int(rec1["k"]) == 4
+    with pytest.raises(mh.MultihostError, match="leader gone"):
+        sub.next_record(timeout_s=0.05, poll_s=0.02)
+
+
+def test_run_follower_replays_until_stop():
+    client = _StubClient()
+    pub = mh.DispatchLog(client=client)
+    pub.publish("prefill", a=np.int32(1))
+    pub.publish("decode", b=np.int32(2))
+    pub.publish("stop")
+
+    calls = []
+
+    class _Eng:
+        _mh_log = mh.DispatchLog(client=client)
+
+        def _replay_prefill(self, rec):
+            calls.append(("prefill", int(rec["a"])))
+
+        def _replay_decode(self, rec):
+            calls.append(("decode", int(rec["b"])))
+
+    mh.run_follower(_Eng(), timeout_s=1)
+    assert calls == [("prefill", 1), ("decode", 2)]
+
+
+def test_run_follower_rejects_unknown_kind_and_unbuilt_engine():
+    client = _StubClient()
+    mh.DispatchLog(client=client).publish("mystery")
+
+    class _Eng:
+        _mh_log = mh.DispatchLog(client=client)
+
+    with pytest.raises(mh.MultihostError, match="mystery"):
+        mh.run_follower(_Eng(), timeout_s=1)
+
+    class _Plain:
+        _mh_log = None
+
+    with pytest.raises(mh.MultihostError, match="multihost=true"):
+        mh.run_follower(_Plain())
+
+
+# ---------------------------------------------------------------------------
+# profile validation
+# ---------------------------------------------------------------------------
+
+
+def test_profile_rejects_divergent_features():
+    ecfg = EngineConfig(speculative_k=2, step_plans=True,
+                        fused_prefill=True, prefix_cache=True,
+                        kv_pager=True)
+    with pytest.raises(mh.MultihostError) as ei:
+        mh.validate_multihost_profile(ecfg)
+    msg = str(ei.value)
+    for feature in ("speculative_k", "step_plans", "fused_prefill",
+                    "prefix_cache", "kv_pager"):
+        assert feature in msg, f"{feature} not named in:\n{msg}"
+
+
+def test_profile_rejects_batch_sharded_mesh(eight_devices):
+    from generativeaiexamples_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(MeshConfig(ici_data=2, ici_tensor=4))
+    with pytest.raises(mh.MultihostError, match="data axis = 2"):
+        mh.validate_multihost_profile(EngineConfig(), mesh)
+    mh.validate_multihost_profile(
+        EngineConfig(), build_mesh(MeshConfig(ici_tensor=8)))
+
+
+# ---------------------------------------------------------------------------
+# replay lockstep: a second engine fed only the dispatch records ends in
+# the leader's exact device state
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine(params, cfg):
+    from generativeaiexamples_tpu.serving.engine import LLMEngine
+    from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
+
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=64, page_size=8,
+                        prefill_buckets=(16,),
+                        pace_emission_max_streams=0, compile_cache_dir="")
+    return LLMEngine(params, cfg, ByteTokenizer(), ecfg,
+                     use_pallas=False)
+
+
+def test_replay_reproduces_leader_device_state():
+    """Leader serves real requests while publishing records to a stub
+    log; a fresh engine replaying ONLY those records (never seeing a
+    request) ends with byte-identical last-token chain and KV pool —
+    the invariant the cross-process follower relies on."""
+    from generativeaiexamples_tpu.models import llama
+    from generativeaiexamples_tpu.serving.engine import GenRequest
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    client = _StubClient()
+
+    leader = _tiny_engine(params, cfg)
+    leader._mh_log = mh.DispatchLog(client=client)
+    leader._mh_leader = True
+    leader.start()
+    for i in range(2):
+        req = GenRequest(prompt_ids=[(7 * i + j) % 250 + 1
+                                     for j in range(10)],
+                         max_new_tokens=6)
+        leader.submit(req)
+        while True:
+            ev = req.stream.get(timeout=120)
+            if ev["finished"]:
+                break
+    leader.stop()  # publishes the stop record
+
+    follower = _tiny_engine(params, cfg)
+    follower._mh_log = mh.DispatchLog(client=client)
+    mh.run_follower(follower, timeout_s=5)
+
+    np.testing.assert_array_equal(np.asarray(leader._last_tokens),
+                                  np.asarray(follower._last_tokens))
+    np.testing.assert_array_equal(np.asarray(leader.pool.k),
+                                  np.asarray(follower.pool.k))
+    np.testing.assert_array_equal(np.asarray(leader.pool.v),
+                                  np.asarray(follower.pool.v))
+    follower.stop()
