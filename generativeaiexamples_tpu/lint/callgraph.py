@@ -25,6 +25,13 @@ query:
 - **Attribute classes** — ``self.x = ClassName(...)`` assignments and
   ``__init__`` parameter annotations, so ``self.metrics.tokens_out``
   resolves to ``EngineMetrics`` without executing anything.
+- **Dispatch-site inventory** — per-call-site line numbers
+  (``call_sites``), jit entry points (``@jax.jit``-family decorated
+  defs, module-level ``NAME = jax.jit(...)`` values, ``partial``
+  rebinds of either), and the control-op seam's deferred targets
+  (``run_control_op(lambda: ...)``), so the GL70x multihost checks and
+  ``--explain-dispatch-site`` can enumerate every device dispatch the
+  scheduler loop can reach (see ``dispatch_inventory``).
 
 Everything is resolved conservatively: an unresolvable call simply
 contributes no edge (checks stay quiet rather than guessing), and
@@ -98,6 +105,19 @@ class CallGraph:
         self.spawns: Dict[str, Set[str]] = {}
         self.file_index: Dict[str, "_FileIndex"] = {}
         self._rcalls: Optional[Dict[str, Set[str]]] = None
+        # caller key -> [(lineno, callee key)] for every RESOLVED direct
+        # call expression (callback references passed as arguments are
+        # call EDGES but not call SITES — they fire elsewhere).
+        self.call_sites: Dict[str, List[Tuple[int, str]]] = {}
+        # functions handed to the engine's control-op seam
+        # (run_control_op(...)): they run later ON the scheduler
+        # thread, so multihost dispatch analysis roots there too.
+        self.control_op_targets: Set[str] = set()
+        # node keys of defs carrying a jit-family decorator
+        self.jit_defs: Set[str] = set()
+        # pseudo keys ("<rel>::<NAME>") of module-level jit VALUES
+        # (`peek = jax.jit(lambda ...)`) — callable, but not FuncNodes
+        self.jit_value_keys: Set[str] = set()
 
     def method_key(self, info: Optional[ClassInfo], name: str,
                    _seen: Optional[Set[Tuple[str, str]]] = None
@@ -157,16 +177,22 @@ class CallGraph:
         return self._rcalls
 
     def reachable(self, roots: Iterable[str], *,
-                  follow_spawns: bool = False) -> Dict[str, Optional[str]]:
+                  follow_spawns: bool = False,
+                  stop_at: Iterable[str] = ()) -> Dict[str, Optional[str]]:
         """BFS over call edges (optionally spawn edges too) from
         ``roots``; returns {reached key: parent key} — parent None for
-        the roots themselves, so chains can be reconstructed."""
+        the roots themselves, so chains can be reconstructed. Nodes in
+        ``stop_at`` are recorded when reached but NOT expanded: GL701
+        uses this to ask "which dispatch sites can the scheduler reach
+        without crossing a DispatchLog.publish seam?"."""
+        stops = set(stop_at)
         parent: Dict[str, Optional[str]] = {}
         q: deque = deque()
         for r in roots:
             if r in self.nodes and r not in parent:
                 parent[r] = None
-                q.append(r)
+                if r not in stops:
+                    q.append(r)
         while q:
             k = q.popleft()
             nxt = set(self.calls.get(k, ()))
@@ -175,7 +201,8 @@ class CallGraph:
             for d in sorted(nxt):
                 if d not in parent:
                     parent[d] = k
-                    q.append(d)
+                    if d not in stops:
+                        q.append(d)
         return parent
 
     @staticmethod
@@ -519,6 +546,49 @@ class _Builder:
             if dst is not None and dst != key:
                 self.graph.spawns.setdefault(key, set()).add(dst)
 
+        def jit_constant_ref(expr) -> Optional[str]:
+            """`NAME(...)` where NAME is a module-level constant bound
+            to `jax.jit(...)` (a jit VALUE, pseudo key) or to
+            `functools.partial(f, ...)` over a local def (the real
+            key of `f`)."""
+            if not isinstance(expr, ast.Name):
+                return None
+            const = idx.constants.get(expr.id)
+            if not isinstance(const, ast.Call):
+                return None
+            if u.is_jit_expr(const.func):
+                pseudo = f"{fn.sf.rel}::{expr.id}"
+                self.graph.jit_value_keys.add(pseudo)
+                return pseudo
+            inner = u.unwrap_partial(const)
+            if inner is not const:
+                return resolve_ref(inner)
+            return None
+
+        def add_control_op_targets(call: ast.Call) -> None:
+            """run_control_op(fn) defers `fn` onto the scheduler
+            thread; resolve what it will call so multihost dispatch
+            analysis can root there. Lambda bodies fall back to a
+            project-unique bare-name match: the idiom is
+            `eng.run_control_op(lambda: eng.export_prefix_pages(...))`
+            through a LOCAL alias the attribute dataflow cannot see."""
+            a0 = call.args[0]
+            if isinstance(a0, ast.Lambda):
+                for c in ast.walk(a0.body):
+                    if not isinstance(c, ast.Call):
+                        continue
+                    ref = resolve_ref(c.func)
+                    if ref is None:
+                        named = self.graph.functions_named(
+                            u.last_part(u.dotted(c.func)))
+                        ref = named[0].key if len(named) == 1 else None
+                    if ref is not None:
+                        self.graph.control_op_targets.add(ref)
+                return
+            ref = resolve_ref(a0)
+            if ref is not None:
+                self.graph.control_op_targets.add(ref)
+
         for node in u.walk_stop_at_functions(fn.node, include_root=False):
             if not isinstance(node, ast.Call):
                 continue
@@ -538,7 +608,15 @@ class _Builder:
                 if target is not None:
                     add_spawn(target)
                     continue
-            add_call(resolve_ref(node.func))
+            if last == "run_control_op" and node.args:
+                add_control_op_targets(node)
+            dst = resolve_ref(node.func)
+            if dst is None:
+                dst = jit_constant_ref(node.func)
+            if dst is not None and dst != key:
+                self.graph.call_sites.setdefault(key, []).append(
+                    (node.lineno, dst))
+            add_call(dst if dst in self.graph.nodes else None)
             # synchronous callbacks: function references passed as args
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 if isinstance(arg, (ast.Name, ast.Attribute)) or (
@@ -566,5 +644,121 @@ def build(project: Project) -> CallGraph:
     b.resolve_bases()
     b.infer_attr_classes()
     b.build_edges()
+    for key, node in b.graph.nodes.items():
+        decos = getattr(node.node, "decorator_list", ())
+        if any(u.jit_static_argnames(d) is not None for d in decos):
+            b.graph.jit_defs.add(key)
     project._graftlint_callgraph = b.graph  # type: ignore[attr-defined]
     return b.graph
+
+
+# -- dispatch-site inventory --------------------------------------------------
+
+
+def entry_name(key: str) -> str:
+    """Display name of a dispatch entry key: the bare function name
+    for FuncNode keys, the constant name for jit-value pseudo keys."""
+    qual = key.split("::", 1)[-1]
+    return qual.rsplit(".", 1)[-1]
+
+
+class DispatchInventory:
+    """Every device-dispatch call site the given roots can reach.
+
+    - ``entries``: the jit-entry closure — directly jitted defs,
+      module-level jit values, plus same-module thin wrappers over
+      them (``plan_step`` -> ``_plan_step`` -> the jitted step fns):
+      the module boundary is where the scheduler hands off, so the
+      cross-module call IS the dispatch site. The closure never grows
+      into a root or into a function that publishes dispatch records
+      (those are scheduler-side, not dispatch-layer).
+    - ``sites``: {scheduler-side function key: [(lineno, entry key)]}.
+    - ``publish_lines``: {function key: [linenos of
+      ``DispatchLog.publish`` calls]}.
+    - ``reach``: parent map of everything reachable from ``roots``
+      over call edges (for chains).
+    """
+
+    def __init__(self, graph: CallGraph, roots: Set[str]):
+        self.graph = graph
+        self.roots = set(roots)
+        self.publish_lines = _publish_lines(graph)
+        self.entries = self._entry_closure()
+        # Everything an entry calls runs INSIDE the traced jit region
+        # (attention dispatch helpers, scan bodies): a call from there
+        # to another jit entry is jit-in-jit during tracing, not a
+        # scheduler-side launch.
+        self.traced = self.entries | set(
+            graph.reachable(sorted(self.entries)))
+        self.sites: Dict[str, List[Tuple[int, str]]] = {}
+        for key, sites in graph.call_sites.items():
+            if key in self.traced:
+                continue
+            hits = [(ln, dst) for ln, dst in sites if dst in self.entries]
+            if hits:
+                self.sites[key] = sorted(hits)
+        self.reach = graph.reachable(sorted(self.roots))
+
+    def _entry_closure(self) -> Set[str]:
+        entries = set(self.graph.jit_defs) | set(self.graph.jit_value_keys)
+        stop = self.roots | set(self.publish_lines)
+        grew = True
+        while grew:
+            grew = False
+            for key, sites in self.graph.call_sites.items():
+                if key in entries or key in stop:
+                    continue
+                rel = key.split("::", 1)[0]
+                for _ln, dst in sites:
+                    if dst in entries and dst.split("::", 1)[0] == rel:
+                        entries.add(key)
+                        grew = True
+                        break
+        return entries
+
+    def reachable_sites(self) -> List[Tuple[str, int, str]]:
+        """(function key, lineno, entry key) for every dispatch site in
+        a function the roots reach, sorted for stable output."""
+        out = []
+        for key, sites in self.sites.items():
+            if key in self.reach:
+                out.extend((key, ln, dst) for ln, dst in sites)
+        return sorted(out)
+
+
+def _publish_lines(graph: CallGraph) -> Dict[str, List[int]]:
+    """Linenos of DispatchLog.publish calls per function: receiver
+    either carries a log-ish name (`self._mh_log.publish(...)`) or has
+    an inferred attribute class literally named DispatchLog."""
+    out: Dict[str, List[int]] = {}
+    for key, node in graph.nodes.items():
+        cls = graph.classes.get((node.sf.rel, node.cls_name)) \
+            if node.cls_name else None
+        for call in u.walk_stop_at_functions(node.node, include_root=False):
+            if not isinstance(call, ast.Call) or \
+                    not isinstance(call.func, ast.Attribute) or \
+                    call.func.attr != "publish":
+                continue
+            recv = call.func.value
+            recv_name = (u.dotted(recv) or "").lower()
+            is_log = "log" in recv_name
+            if not is_log and cls is not None:
+                attr = u.self_attr_target(recv)
+                owner = cls.attr_cls.get(attr) if attr else None
+                is_log = owner is not None and owner[1] == "DispatchLog"
+            if is_log:
+                out.setdefault(key, []).append(call.lineno)
+    return out
+
+
+def dispatch_inventory(project: Project,
+                       roots: Set[str]) -> DispatchInventory:
+    """Build (and memoize per root set) the dispatch-site inventory."""
+    cache = getattr(project, "_graftlint_dispatch_inv", None)
+    if cache is None:
+        cache = {}
+        project._graftlint_dispatch_inv = cache  # type: ignore
+    key = frozenset(roots)
+    if key not in cache:
+        cache[key] = DispatchInventory(build(project), set(roots))
+    return cache[key]

@@ -18,6 +18,9 @@ from generativeaiexamples_tpu.lint.checks.persistence import \
     AtomicPersistenceCheck
 from generativeaiexamples_tpu.lint.checks.metrics_contract import \
     MetricsContractCheck
+from generativeaiexamples_tpu.lint.checks.multihost_safety import (
+    MultihostPublishCheck, MultihostFetchSeamCheck,
+    MultihostDivergenceCheck, MultihostRankBranchCheck)
 
 ALL_CHECKS = [
     TracePurityCheck,
@@ -30,4 +33,8 @@ ALL_CHECKS = [
     ConfigDriftCheck,
     AtomicPersistenceCheck,
     MetricsContractCheck,
+    MultihostPublishCheck,
+    MultihostFetchSeamCheck,
+    MultihostDivergenceCheck,
+    MultihostRankBranchCheck,
 ]

@@ -108,6 +108,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="print the hot-path root->FUNC call chain "
                         "(FUNC = name, Class.name, or module.py:name) "
                         "and exit: 0 hot, 1 not hot, 2 unknown")
+    p.add_argument("--explain-dispatch-site", metavar="FUNC",
+                   help="print FUNC's device-dispatch sites with their "
+                        "scheduler-root->FUNC chains and publish "
+                        "coverage (the GL701 inventory) and exit: "
+                        "0 scheduler-reachable sites, 1 none, "
+                        "2 unknown function")
     p.add_argument("--list-checks", action="store_true",
                    help="print the check catalog and exit")
     return p
@@ -208,6 +214,79 @@ def _explain_hot_path(project, spec: str) -> int:
     return 0 if any_hot else 1
 
 
+def _explain_dispatch_site(project, spec: str) -> int:
+    """GL701's inventory, queryable: FUNC's dispatch sites (or the
+    sites dispatching INTO it when FUNC is a jit entry), each with its
+    scheduler-root chain and publish coverage."""
+    from generativeaiexamples_tpu.lint import callgraph
+    from generativeaiexamples_tpu.lint.checks import multihost_safety
+
+    graph = callgraph.build(project)
+    inv = multihost_safety.inventory_for(project)
+    matches = graph.functions_named(spec)
+    keys = [n.key for n in matches]
+    # jit VALUES (module constants) are not FuncNodes but are entries
+    keys += [k for k in sorted(inv.entries)
+             if k not in graph.nodes and callgraph.entry_name(k) == spec]
+    if not keys:
+        print(f"error: no function matching {spec!r} in the linted "
+              f"paths (try Class.name or module.py:name)",
+              file=sys.stderr)
+        return 2
+    publishers = sorted(inv.publish_lines)
+    unpub = graph.reachable(sorted(inv.roots), stop_at=publishers)
+    any_reachable = False
+    for key in keys:
+        if key in inv.entries:
+            # entry: show every scheduler-side site dispatching into it
+            holders = [(k, ln) for k, sites in sorted(inv.sites.items())
+                       for ln, dst in sites if dst == key]
+            name = callgraph.entry_name(key)
+            if not holders:
+                print(f"{name} is a jit entry with no resolved "
+                      f"scheduler-side dispatch site")
+                continue
+            print(f"{name} is a jit entry; dispatch sites:")
+            for k, ln in holders:
+                n = graph.nodes[k]
+                mark = _publish_mark(inv, unpub, k, ln)
+                print(f"  {n.sf.rel}:{ln} in {n.qual} [{mark}]")
+                any_reachable |= k in inv.reach
+            continue
+        sites = inv.sites.get(key, [])
+        node = graph.nodes[key]
+        if not sites:
+            print(f"{node.sf.rel}:{node.node.lineno} {node.qual} has no "
+                  f"dispatch sites in the inventory")
+            continue
+        reach_here = key in inv.reach
+        any_reachable |= reach_here
+        state = "scheduler-reachable" if reach_here else \
+            "NOT reachable from a scheduler root"
+        print(f"{node.sf.rel}:{node.node.lineno} {node.qual} "
+              f"({state}) dispatch sites:")
+        for ln, dst in sites:
+            mark = _publish_mark(inv, unpub, key, ln)
+            print(f"  line {ln}: {callgraph.entry_name(dst)} [{mark}]")
+        if reach_here:
+            chain = graph.chain(inv.reach, key)
+            for i, k in enumerate(chain):
+                n = graph.nodes[k]
+                root_mark = " (root)" if inv.reach[k] is None else ""
+                print(f"  {'  ' * i}-> {n.module}:{n.qual}{root_mark}")
+    return 0 if any_reachable else 1
+
+
+def _publish_mark(inv, unpub, key: str, ln: int) -> str:
+    if any(p < ln for p in inv.publish_lines.get(key, ())):
+        return "published in-function"
+    if key not in inv.reach:
+        return "off the scheduler path"
+    if key not in unpub:
+        return "publish-covered on every scheduler path"
+    return "UNPUBLISHED"
+
+
 # Minimal SARIF 2.1.0 — enough for GitHub/GitLab code-annotation
 # ingestion: one run, one rule per check id, results with physical
 # locations and the baseline content hash as a stable fingerprint.
@@ -285,6 +364,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.explain_hot_path:
         return _explain_hot_path(project, args.explain_hot_path)
+
+    if args.explain_dispatch_site:
+        return _explain_dispatch_site(project, args.explain_dispatch_site)
 
     findings = run_checks(project, checks)
     floor = SEVERITIES.index(args.min_severity)

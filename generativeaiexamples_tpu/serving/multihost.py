@@ -216,31 +216,42 @@ def validate_multihost_profile(ecfg, mesh=None) -> None:
     """Reject engine configs the replay protocol cannot keep in lockstep,
     each with the reason and the fix — a silently-diverging dispatch
     sequence deadlocks the slice, which is strictly worse."""
+    # Each rejection names the graftlint check (GL70x) that guards the
+    # invariant the feature would break — tests/test_multihost.py pins
+    # this list against the registered lint catalog.
     bad = []
     if ecfg.speculative_k:
         bad.append("speculative_k > 0: draft/verify widths depend on "
-                   "leader-side acceptance state; set speculative_k=0")
+                   "leader-side acceptance state (replay-divergence, "
+                   "GL703); set speculative_k=0")
     if ecfg.step_plans:
         bad.append("step_plans: the plan lattice point is chosen from "
-                   "scheduler state followers don't see; set "
-                   "step_plans=false")
+                   "scheduler state followers don't see "
+                   "(replay-divergence, GL703); set step_plans=false")
     if ecfg.fused_prefill:
         bad.append("fused_prefill: rider chunks are picked from the "
-                   "admission queue; set fused_prefill=false")
+                   "admission queue and dispatched without a published "
+                   "record (publish-before-launch, GL701); set "
+                   "fused_prefill=false")
     if ecfg.prefix_cache:
         bad.append("prefix_cache: cache seeding issues extra device "
-                   "gathers on hits; set prefix_cache=false")
+                   "gathers on hits that never cross DispatchLog.publish "
+                   "(publish-before-launch, GL701); set "
+                   "prefix_cache=false")
     if ecfg.kv_pager:
-        bad.append("kv_pager: HBM<->host page moves are per-host state; "
-                   "set kv_pager=false")
+        bad.append("kv_pager: HBM<->host page moves are per-host state — "
+                   "spill materializes pages outside the fetch seams "
+                   "(fetch-seam, GL702) and pressure branches are "
+                   "per-rank (rank-branch, GL704); set kv_pager=false")
     if mesh is not None:
         for ax in ("data", "fsdp"):
             if int(mesh.shape.get(ax, 1)) > 1:
                 bad.append(
                     f"mesh {ax} axis = {mesh.shape[ax]}: batch-sharded "
                     f"token outputs are not fully replicated, so rank 0 "
-                    f"cannot read sampled tokens; keep {ax}=1 and put "
-                    f"devices on tensor/sequence")
+                    f"cannot read sampled tokens through the replicated "
+                    f"fetch seam (fetch-seam, GL702); keep {ax}=1 and "
+                    f"put devices on tensor/sequence")
     if bad:
         raise MultihostError(
             "engine.multihost=true rejects this config:\n  - "

@@ -139,7 +139,7 @@ class TierScheduler:
                 break
             by_tier.setdefault(request_tier(req), []).append(i)
         present = frozenset(by_tier)
-        for t in present - self._backlogged:
+        for t in present - self._backlogged:  # graftlint: ignore[GL703] order-independent: each tier's credit is reset in isolation, so set iteration order cannot change any pick
             # Idle -> backlogged: no credit for the idle period.
             self.served[t] = max(self.served[t],
                                  self.vtime * self.weights[t])

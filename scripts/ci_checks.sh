@@ -10,7 +10,14 @@
 #                   lock discipline + cross-thread races, thread
 #                   hygiene, call-graph-inferred hot-path host-sync,
 #                   atomic persistence, metrics contract, config
-#                   drift); zero non-baselined findings required, and
+#                   drift, and the GL701-GL704 multihost collective-
+#                   safety family: publish-before-launch dispatch
+#                   inventory, fetch-seam enforcement, replay-
+#                   divergence sources, rank-branched launches — all
+#                   in this same single gating pass, so the SARIF
+#                   artifact and --changed reverse-dependency scoping
+#                   cover them for free);
+#                   zero non-baselined findings required, and
 #                   STALE baseline entries (fixed code) fail the step
 #                   (--fail-stale) so the baseline shrinks over time.
 #                   A SARIF artifact lands at build/lint.sarif for CI

@@ -6,6 +6,7 @@
     python scripts/lint.py --changed     # diff-scoped pre-commit run
     python scripts/lint.py path/ --select GL201   # args forwarded
     python scripts/lint.py --explain-hot-path _prefill_group
+    python scripts/lint.py --explain-dispatch-site plan_step
 
 graftlint (generativeaiexamples_tpu/lint/) is the JAX-serving-aware
 pass: trace purity, lock discipline + cross-thread races, thread
@@ -16,7 +17,10 @@ but reports only findings in git-changed files AND their reverse
 call-graph dependents — the fast pre-commit loop. ruff covers the
 generic pycodestyle/pyflakes/bugbear subset configured in
 pyproject.toml; the container doesn't ship it, so `--ruff` skips
-gracefully (exit 0 for that step) when it is not importable/runnable.
+gracefully (exit 0 for that step) when it is cleanly absent. A ruff
+installation that is PRESENT but broken (the package import itself
+raises) exits 2: "lint ran and skipped a step it was asked to run" is
+a usage error, not a pass.
 
 Exit code: nonzero when any executed step found problems (graftlint's
 0/1/2 contract is preserved when ruff is skipped or clean).
@@ -37,18 +41,32 @@ DEFAULT_PATHS = [os.path.join(REPO, "generativeaiexamples_tpu")]
 
 def run_ruff(paths) -> int:
     exe = shutil.which("ruff")
-    if exe is None:
+    if exe is not None:
+        print(f"lint.py: running ruff check ({exe})")
+        return subprocess.run([exe, "check", *paths], cwd=REPO).returncode
+    # No binary on PATH: distinguish "cleanly absent" (skip, 0) from
+    # "present but broken" (exit 2 — the step was requested and cannot
+    # honestly be reported as passing).
+    import importlib.util
+    try:
+        spec = importlib.util.find_spec("ruff")
+    except (ImportError, ValueError) as exc:
+        print(f"lint.py: --ruff requested but the ruff package import "
+              f"failed ({exc}); fix or drop --ruff", file=sys.stderr)
+        return 2
+    if spec is None:
         print("lint.py: ruff not installed — skipping the ruff step "
               "(config lives in pyproject.toml [tool.ruff])")
         return 0
-    print(f"lint.py: running ruff check ({exe})")
-    proc = subprocess.run([exe, "check", *paths], cwd=REPO)
-    return proc.returncode
+    print("lint.py: running ruff check (python -m ruff)")
+    return subprocess.run(
+        [sys.executable, "-m", "ruff", "check", *paths], cwd=REPO,
+    ).returncode
 
 
 VALUE_FLAGS = {"--select", "--ignore", "--baseline", "--write-baseline",
                "--min-severity", "--format", "--explain-hot-path",
-               "--sarif-out"}
+               "--explain-dispatch-site", "--sarif-out"}
 
 
 def positional_paths(args):

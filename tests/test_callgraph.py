@@ -327,3 +327,181 @@ class TestDependents:
         assert len(g.functions_named("step")) == 2
         assert [n.qual for n in g.functions_named("E.step")] == ["E.step"]
         assert len(g.functions_named("engine.py:step")) == 2
+
+
+class TestDispatchInventory:
+    """The GL701 dispatch-site inventory: jit entries (defs, wrapped
+    lambdas, partial-unwrapped values), the same-module wrapper
+    closure, per-site linenos, and the control-op seam roots."""
+
+    def _inv(self, root, files, root_quals):
+        g = build(root, files)
+        roots = {node_named(g, q).key for q in root_quals}
+        return g, callgraph.DispatchInventory(g, roots)
+
+    def test_jitted_defs_both_decorator_shapes(self, tmp_path):
+        g, inv = self._inv(tmp_path, {"m.py": """\
+            import functools
+
+            import jax
+
+
+            @jax.jit
+            def bare(x):
+                return x
+
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def with_static(x, n):
+                return x
+
+
+            def plain(x):
+                return x
+
+
+            def _loop():
+                bare(1)
+                with_static(1, 2)
+                plain(1)
+        """}, ["_loop"])
+        entry_names = {callgraph.entry_name(k) for k in inv.entries}
+        assert entry_names == {"bare", "with_static"}
+        dispatched = {callgraph.entry_name(d)
+                      for _, _, d in inv.reachable_sites()}
+        assert dispatched == {"bare", "with_static"}  # plain: no site
+
+    def test_jit_wrapped_lambda_value_is_an_entry(self, tmp_path):
+        g, inv = self._inv(tmp_path, {"m.py": """\
+            import jax
+
+            peek = jax.jit(lambda x: x)
+
+
+            def _loop():
+                return peek(3)
+        """}, ["_loop"])
+        assert "m.py::peek" in inv.entries
+        assert callgraph.entry_name("m.py::peek") == "peek"
+        sites = inv.sites[node_named(g, "_loop").key]
+        assert sites == [(7, "m.py::peek")]
+
+    def test_partial_unwrapped_value_resolves_to_jit_def(self, tmp_path):
+        g, inv = self._inv(tmp_path, {"m.py": """\
+            import functools
+
+            import jax
+
+
+            @jax.jit
+            def step(cfg, x):
+                return x
+
+
+            step2 = functools.partial(step, "cfg")
+
+
+            def _loop():
+                return step2(4)
+        """}, ["_loop"])
+        step_key = node_named(g, "step").key
+        sites = inv.sites[node_named(g, "_loop").key]
+        assert sites == [(15, step_key)]  # partial peeled to the jit def
+
+    def test_same_module_wrapper_closure_site_at_module_boundary(
+            self, tmp_path):
+        g, inv = self._inv(tmp_path, {
+            "pkg/model.py": """\
+                import jax
+
+
+                @jax.jit
+                def core_step(x):
+                    return x
+
+
+                def run_step(x):
+                    return core_step(x)
+            """,
+            "pkg/sched.py": """\
+                from pkg.model import run_step
+
+
+                def _loop():
+                    return run_step(5)
+            """,
+        }, ["_loop"])
+        wrapper_key = node_named(g, "run_step").key
+        # the wrapper joins the entry closure: the scheduler's cross-
+        # module call into it IS the dispatch site ...
+        assert wrapper_key in inv.entries
+        assert inv.sites[node_named(g, "_loop").key] == \
+            [(5, wrapper_key)]
+        # ... and the wrapper's own call into core_step is traced
+        # hand-off, not a second site
+        assert wrapper_key not in inv.sites
+
+    def test_traced_region_calls_are_not_sites(self, tmp_path):
+        g, inv = self._inv(tmp_path, {"m.py": """\
+            import jax
+
+
+            @jax.jit
+            def inner(x):
+                return x
+
+
+            @jax.jit
+            def outer(x):
+                return helper(x)
+
+
+            def helper(x):
+                return inner(x)   # jit-in-jit during tracing
+
+
+            def _loop():
+                return outer(6)
+        """}, ["_loop"])
+        assert node_named(g, "helper").key in inv.traced
+        assert node_named(g, "helper").key not in inv.sites
+        dispatched = {callgraph.entry_name(d)
+                      for _, _, d in inv.reachable_sites()}
+        assert dispatched == {"outer"}
+
+    def test_publisher_stays_scheduler_side(self, tmp_path):
+        g, inv = self._inv(tmp_path, {"m.py": """\
+            import jax
+
+
+            @jax.jit
+            def step(x):
+                return x
+
+
+            class Eng:
+                def _loop(self):
+                    self._beat()
+
+                def _beat(self):
+                    self._mh_log.publish("step")
+                    return step(7)
+        """}, ["Eng._loop"])
+        beat_key = node_named(g, "Eng._beat").key
+        # _beat calls a same-module jit entry but publishes dispatch
+        # records, so the closure must NOT absorb it: it keeps its
+        # site (and its publish lineno precedes the launch lineno)
+        assert beat_key not in inv.entries
+        assert inv.sites[beat_key] == [(15, node_named(g, "step").key)]
+        assert inv.publish_lines[beat_key] == [14]
+
+    def test_control_op_lambda_targets_become_roots(self, tmp_path):
+        g = build(tmp_path, {"m.py": """\
+            def export_pages(eng):
+                return eng
+
+
+            def handler(eng):
+                run_control_op(lambda: export_pages(eng))
+        """})
+        assert g.control_op_targets == {node_named(g, "export_pages").key}

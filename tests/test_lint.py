@@ -507,6 +507,213 @@ CONFIG_APP_CLEAN = """\
 """
 
 
+# GL70x multihost collective-safety: every fixture is a file named
+# engine.py so `_loop` registers as the scheduler root.
+
+MH_PUBLISH_BAD = """\
+    import functools
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plan_step(state, n):
+        return state
+
+
+    class DispatchLog:
+        def publish(self, record):
+            return record
+
+
+    class Engine:
+        def __init__(self):
+            self._mh_log = DispatchLog()
+
+        def _loop(self):
+            self._dispatch_plan(1)
+
+        def _dispatch_plan(self, n):
+            out = plan_step({}, n)               # launched first ...
+            self._mh_log.publish(("plan", n))    # ... published after
+            return out
+"""
+
+MH_PUBLISH_CLEAN = """\
+    import functools
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plan_step(state, n):
+        return state
+
+
+    class DispatchLog:
+        def publish(self, record):
+            return record
+
+
+    class Engine:
+        def __init__(self):
+            self._mh_log = DispatchLog()
+
+        def _loop(self):
+            self._dispatch_plan(1)
+
+        def _dispatch_plan(self, n):
+            self._mh_log.publish(("plan", n))    # publish, THEN launch
+            return plan_step({}, n)
+"""
+
+MH_FETCH_BAD = """\
+    import numpy as np
+
+
+    class Engine:
+        def _loop(self):
+            self._emit()
+
+        def _emit(self):
+            return np.asarray(self._last_dev)  # bypasses the fetch seams
+"""
+
+MH_FETCH_CLEAN = """\
+    import numpy as np
+
+
+    def fetch_replicated(arr):
+        return np.asarray(arr)
+
+
+    class Engine:
+        def _loop(self):
+            self._emit()
+
+        def _emit(self):
+            return fetch_replicated(self._last)
+"""
+
+MH_DIVERGE_BAD = """\
+    import functools
+    import time
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plan_step(state, n):
+        return state
+
+
+    class DispatchLog:
+        def publish(self, record):
+            return record
+
+
+    class Engine:
+        def __init__(self):
+            self._mh_log = DispatchLog()
+            self._tiers = {"bulk", "interactive"}
+
+        def _loop(self):
+            n = self._pick_width()
+            self._mh_log.publish(("plan", n))
+            plan_step({}, n)
+
+        def _pick_width(self):
+            for tier in self._tiers:               # unordered iteration
+                if tier == "interactive":
+                    return 1
+            return int(time.perf_counter()) % 4    # wall-clock decision
+"""
+
+MH_DIVERGE_CLEAN = """\
+    import functools
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plan_step(state, n):
+        return state
+
+
+    class DispatchLog:
+        def publish(self, record):
+            return record
+
+
+    class Engine:
+        def __init__(self):
+            self._mh_log = DispatchLog()
+            self._widths = [1, 2, 4]
+
+        def _loop(self):
+            n = self._pick_width()
+            self._mh_log.publish(("plan", n))
+            plan_step({}, n)
+
+        def _pick_width(self):
+            return self._widths[0]   # deterministic scheduler state
+"""
+
+MH_RANK_BAD = """\
+    import functools
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plan_step(state, n):
+        return state
+
+
+    class DispatchLog:
+        def publish(self, record):
+            return record
+
+
+    class Engine:
+        def __init__(self):
+            self._mh_log = DispatchLog()
+            self._mh_leader = True
+
+        def _loop(self):
+            self._mh_log.publish("plan")
+            if self._mh_leader:
+                plan_step({}, 1)   # guarded launch: ranks diverge
+"""
+
+MH_RANK_CLEAN = """\
+    import functools
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plan_step(state, n):
+        return state
+
+
+    class DispatchLog:
+        def publish(self, record):
+            return record
+
+
+    class Engine:
+        def __init__(self):
+            self._mh_log = DispatchLog()
+            self._mh_leader = True
+
+        def _loop(self):
+            if self._mh_leader:                  # leader-guarded PUBLISH
+                self._mh_log.publish("plan")     # is the protocol: quiet
+            plan_step({}, 1)                     # launch on every rank
+"""
+
+
 # ---------------------------------------------------------------------------
 # per-check detection
 # ---------------------------------------------------------------------------
@@ -785,6 +992,95 @@ class TestConfigDrift:
 # ---------------------------------------------------------------------------
 
 
+class TestMultihostPublish:
+    def test_fires_on_publish_after_launch(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_PUBLISH_BAD})])
+        gl701 = [f for f in findings if f.check == "GL701"]
+        assert len(gl701) == 1, [f.format() for f in findings]
+        msg = gl701[0].message
+        assert "plan_step" in msg
+        assert "DispatchLog.publish" in msg
+        # the finding embeds its scheduler-root->dispatch chain
+        assert "Engine._loop" in msg and "Engine._dispatch_plan" in msg
+        assert "--explain-dispatch-site" in msg
+
+    def test_quiet_when_published_before_launch(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_PUBLISH_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestMultihostFetchSeam:
+    def test_fires_on_raw_materialization(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_FETCH_BAD})])
+        gl702 = [f for f in findings if f.check == "GL702"]
+        assert len(gl702) == 1, [f.format() for f in findings]
+        assert "fetch_replicated" in gl702[0].message
+
+    def test_quiet_through_the_sanctioned_seam(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_FETCH_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestMultihostDivergence:
+    def test_fires_on_clock_and_set_iteration(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_DIVERGE_BAD})])
+        gl703 = [f for f in findings if f.check == "GL703"]
+        msgs = " ".join(f.message for f in gl703)
+        assert len(gl703) == 2, [f.format() for f in findings]
+        assert "wall-clock" in msgs
+        assert "unordered set" in msgs
+
+    def test_quiet_on_deterministic_decision(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_DIVERGE_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestMultihostRankBranch:
+    def test_fires_on_guarded_launch(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_RANK_BAD})])
+        gl704 = [f for f in findings if f.check == "GL704"]
+        assert len(gl704) == 1, [f.format() for f in findings]
+        assert "plan_step" in gl704[0].message
+
+    def test_leader_guarded_publish_is_quiet(self, tmp_path):
+        findings = lint_paths(
+            [write_tree(tmp_path, {"engine.py": MH_RANK_CLEAN})])
+        assert ids_of(findings) == set()
+
+
+class TestDispatchInventoryPin:
+    """The replay protocol's known-good set: scripts/smoke_multihost.py
+    drives prefill, token feedback, and decode through the DispatchLog.
+    The GL701 inventory must see AT LEAST those dispatch points — if a
+    refactor renames a lane out of the inventory, a new unpublished
+    dispatch could land silently and this pin fails first."""
+
+    SMOKE_DISPATCHES = {"prefill_batch_step", "set_last_tokens",
+                        "plan_step"}
+
+    def test_inventory_superset_of_smoke_dispatches(self):
+        from generativeaiexamples_tpu.lint import callgraph
+        from generativeaiexamples_tpu.lint.checks.multihost_safety \
+            import inventory_for
+        from generativeaiexamples_tpu.lint.core import load_project
+
+        inv = inventory_for(load_project([PKG]))
+        reachable = {callgraph.entry_name(dst)
+                     for _, _, dst in inv.reachable_sites()}
+        missing = self.SMOKE_DISPATCHES - reachable
+        assert not missing, (
+            f"dispatch points exercised by scripts/smoke_multihost.py "
+            f"missing from the scheduler-reachable GL701 inventory: "
+            f"{sorted(missing)}; reachable={sorted(reachable)}")
+
+
 class TestSuppression:
     def test_inline_ignore_on_finding_line(self, tmp_path):
         src = LOCK_BAD.replace(
@@ -934,6 +1230,10 @@ class TestCLI:
                    "docs/configuration.md": CONFIG_DOCS_MISSING_BETA}),
         ("GL502", {"mod.py": PERSIST_BAD}),
         ("GL601", {"mod.py": METRICS_BAD}),
+        ("GL701", {"engine.py": MH_PUBLISH_BAD}),
+        ("GL702", {"engine.py": MH_FETCH_BAD}),
+        ("GL703", {"engine.py": MH_DIVERGE_BAD}),
+        ("GL704", {"engine.py": MH_RANK_BAD}),
     ])
     def test_exit_1_per_seeded_fixture(self, tmp_path, check_id, files):
         root = write_tree(tmp_path, files)
@@ -946,6 +1246,10 @@ class TestCLI:
         {"mod.py": RACE_CLEAN},
         {"mod.py": METRICS_CLEAN},
         {"mod.py": PERSIST_CLEAN},
+        {"engine.py": MH_PUBLISH_CLEAN},
+        {"engine.py": MH_FETCH_CLEAN},
+        {"engine.py": MH_DIVERGE_CLEAN},
+        {"engine.py": MH_RANK_CLEAN},
     ])
     def test_exit_0_per_clean_counterpart(self, tmp_path, files):
         root = write_tree(tmp_path, files)
@@ -973,7 +1277,8 @@ class TestCLI:
         proc = run_cli("--list-checks")
         assert proc.returncode == 0
         for cid in ("GL101", "GL201", "GL202", "GL301", "GL302", "GL401",
-                    "GL402", "GL501", "GL502", "GL601"):
+                    "GL402", "GL501", "GL502", "GL601", "GL701", "GL702",
+                    "GL703", "GL704"):
             assert cid in proc.stdout
 
     def test_json_format(self, tmp_path):
@@ -1002,6 +1307,37 @@ class TestCLI:
             < proc.stdout.index("Engine._dispatch") \
             < proc.stdout.rindex("fetch_stats")
         assert "(root)" in proc.stdout
+
+    def test_explain_dispatch_site_prints_root_first_chain(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": MH_PUBLISH_BAD})
+        proc = run_cli(root, "--explain-dispatch-site", "_dispatch_plan")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "plan_step" in proc.stdout
+        assert "UNPUBLISHED" in proc.stdout   # launched before publish
+        # chain prints root-first: _loop (root) above _dispatch_plan
+        loop_at = proc.stdout.index("Engine._loop (root)")
+        site_at = proc.stdout.rindex("Engine._dispatch_plan")
+        assert loop_at < site_at, proc.stdout
+
+    def test_explain_dispatch_site_jit_entry_lists_holders(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": MH_PUBLISH_CLEAN})
+        proc = run_cli(root, "--explain-dispatch-site", "plan_step")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "jit entry" in proc.stdout
+        assert "Engine._dispatch_plan" in proc.stdout
+        assert "published in-function" in proc.stdout
+
+    def test_explain_dispatch_site_no_sites_exits_1(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": MH_PUBLISH_CLEAN})
+        proc = run_cli(root, "--explain-dispatch-site", "publish")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "no dispatch sites" in proc.stdout
+
+    def test_explain_dispatch_site_unknown_exits_2(self, tmp_path):
+        root = write_tree(tmp_path, {"engine.py": MH_PUBLISH_CLEAN})
+        proc = run_cli(root, "--explain-dispatch-site", "nope_never")
+        assert proc.returncode == 2
+        assert "no function matching" in proc.stderr
 
     def test_explain_hot_path_cold_function_exits_1(self, tmp_path):
         root = write_tree(tmp_path, {"engine.py": INFER_CLEAN})
@@ -1064,6 +1400,9 @@ class TestCLI:
         gated = run_cli(fixed, "--baseline", bl_path, "--fail-stale")
         assert gated.returncode == 1, gated.stdout + gated.stderr
         assert "stale baseline entry" in gated.stderr
+        # the message names the owning check, not just the content
+        # hash — a hash alone is undiagnosable in CI logs
+        assert "GL201" in gated.stderr, gated.stderr
 
     def test_fail_stale_ignores_incomplete_runs(self, tmp_path):
         # A raised severity floor filters findings BEFORE the baseline
@@ -1137,6 +1476,48 @@ class TestChangedScope:
         assert proc.returncode == 1, proc.stdout + proc.stderr
         assert "caller.py" in proc.stdout
 
+    def test_changed_scopes_gl701_through_reverse_deps(self, tmp_path):
+        # The GL70x inventory is interprocedural: editing the MODULE
+        # THAT DEFINES the jit entry must pull the scheduler file that
+        # dispatches it (its reverse dependent) back into --changed
+        # scope, or an edit to the model layer could silently invalidate
+        # a publish conclusion.
+        root = write_tree(tmp_path, {
+            "pkg/model.py": """\
+                import functools
+
+                import jax
+
+
+                @functools.partial(jax.jit, static_argnames=("n",))
+                def plan_step(state, n):
+                    return state
+            """,
+            "pkg/engine.py": """\
+                from pkg.model import plan_step
+
+
+                class Engine:
+                    def _loop(self):
+                        plan_step({}, 1)   # never published
+            """,
+        })
+        for args in (("init", "-q"), ("add", "-A"),
+                     ("-c", "user.email=t@t", "-c", "user.name=t",
+                      "commit", "-qm", "seed")):
+            proc = self._git(root, *args)
+            assert proc.returncode == 0, proc.stderr
+        # touch ONLY the model module
+        with open(os.path.join(root, "pkg", "model.py"), "a") as fh:
+            fh.write("\n\nEXTRA = 1\n")
+        proc = subprocess.run(
+            CLI + [os.path.join(root, "pkg"), "--no-baseline",
+                   "--changed"],
+            cwd=root, text=True, capture_output=True, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "GL701" in proc.stdout
+        assert "engine.py" in proc.stdout
+
     def test_changed_clean_when_nothing_changed(self, tmp_path):
         root = write_tree(tmp_path, {"pkg/loner.py": LOCK_BAD})
         for args in (("init", "-q"), ("add", "-A"),
@@ -1177,6 +1558,13 @@ class TestShippedTree:
 
     def test_cli_exit_0_on_shipped_tree(self):
         proc = run_cli("generativeaiexamples_tpu/")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_gl70x_select_exit_0_on_shipped_tree(self):
+        # ISSUE 19 acceptance gate: the multihost collective-safety
+        # family passes the shipped tree with only baselined findings.
+        proc = run_cli("generativeaiexamples_tpu/", "--select",
+                       "GL701,GL702,GL703,GL704")
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
@@ -1266,6 +1654,40 @@ class TestMultihostSeamMarkers:
         gl401 = [f for f in lint_paths([bad_root]) if f.check == "GL401"]
         assert len(gl401) == 1, [f.format() for f in gl401]
         assert "block_until_ready" in gl401[0].message
+
+
+class TestLintScript:
+    """scripts/lint.py --ruff: cleanly-absent ruff skips with 0; a
+    PRESENT-but-broken ruff package (import machinery raises) exits 2
+    instead of silently reporting the requested step as passing."""
+
+    def _load(self):
+        import importlib.util as iu
+        spec = iu.spec_from_file_location(
+            "lint_script_under_test",
+            os.path.join(REPO, "scripts", "lint.py"))
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_ruff_broken_package_import_exits_2(self, monkeypatch, capsys):
+        import importlib.util
+        mod = self._load()
+        monkeypatch.setattr(mod.shutil, "which", lambda name: None)
+
+        def broken(name):
+            raise ImportError("broken ruff install")
+
+        monkeypatch.setattr(importlib.util, "find_spec", broken)
+        assert mod.run_ruff(["pkg"]) == 2
+        assert "--ruff requested" in capsys.readouterr().err
+
+    def test_ruff_cleanly_absent_skips_with_0(self, monkeypatch):
+        import importlib.util
+        mod = self._load()
+        monkeypatch.setattr(mod.shutil, "which", lambda name: None)
+        monkeypatch.setattr(importlib.util, "find_spec", lambda name: None)
+        assert mod.run_ruff(["pkg"]) == 0
 
 
 class TestMultihostGaugeSurfacing:

@@ -179,6 +179,31 @@ def test_profile_rejects_divergent_features():
         assert feature in msg, f"{feature} not named in:\n{msg}"
 
 
+def test_profile_rejections_name_guarding_lint_checks():
+    """Every rejection names the GL70x check that guards the invariant,
+    and together they cover exactly the registered GL70x catalog — so
+    the error text and the lint family cannot drift apart."""
+    import re
+
+    from generativeaiexamples_tpu.lint.checks import ALL_CHECKS
+
+    ecfg = EngineConfig(speculative_k=2, step_plans=True,
+                        fused_prefill=True, prefix_cache=True,
+                        kv_pager=True)
+    with pytest.raises(mh.MultihostError) as ei:
+        mh.validate_multihost_profile(ecfg)
+    lines = str(ei.value).splitlines()[1:]  # drop the header line
+    for line in lines:
+        assert re.search(r"GL70\d", line), \
+            f"rejection does not name its guarding check: {line!r}"
+    named = set(re.findall(r"GL70\d", str(ei.value)))
+    catalog = {c.id for c in ALL_CHECKS if c.id.startswith("GL70")}
+    # The mesh-axis rejection (not triggerable without a multi-device
+    # mesh here) also cites GL702, so the config-only rejections must
+    # already cover the full family.
+    assert named == catalog, (named, catalog)
+
+
 def test_profile_rejects_batch_sharded_mesh(eight_devices):
     from generativeaiexamples_tpu.parallel.mesh import build_mesh
 
