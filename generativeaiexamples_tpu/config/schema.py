@@ -351,7 +351,11 @@ class EngineConfig:
     # holds. Off by default — off is byte-identical to the PR-1 cache.
     kv_pager: bool = False
     # Host-RAM budget for the warm tier, in MB (0 = no host tier:
-    # demotions go straight to the disk spill).
+    # demotions go straight to the disk spill). PER-HOST: under a
+    # multi-host mesh each rank's host/disk tiers park only its
+    # addressable shard slice of a page (kv_pager slice mode), so the
+    # fleet's cold capacity scales with host count at constant
+    # per-host RAM.
     kv_host_budget_mb: int = 256
     # Directory for the cold tier's spill file ("" = a per-engine temp
     # dir, removed at shutdown). The file is grown and compacted
@@ -400,11 +404,13 @@ class EngineConfig:
     enable_pallas_kernels: bool = True
     compile_cache_dir: str = "/tmp/gaie_tpu/compile_cache"
     # Multi-host serving (jax.distributed over DCN): rank 0 runs the
-    # scheduler + OpenAI surface, follower ranks replay its device
-    # dispatches so cross-process collectives pair up by launch order
-    # (serving/multihost.py). Requires the restricted multihost profile
-    # (no speculation / fused prefill / prefix cache / kv pager —
-    # validated with actionable errors at build). Off = byte-identical
+    # scheduler + OpenAI surface, follower ranks replay its published
+    # dispatch records (a self-describing kind + host scalars per
+    # launch) so cross-process collectives pair up by launch order
+    # (serving/multihost.py). Speculation, step plans, fused prefill +
+    # fused sampling, the prefix cache and the kv pager all replay;
+    # only batch-sharded meshes (data/fsdp > 1) are rejected at build
+    # with the fetch-seam rationale. Off = byte-identical
     # single-process engine.
     multihost: bool = False
     # Size the paged-KV pool from serving/memory_plan.py instead of the

@@ -238,16 +238,21 @@ class _LongPrefill:
     scheduler that iterates without landing a block. With no live
     decode traffic, chunks run at full dispatch speed."""
 
-    __slots__ = ("req", "slot_idx", "seq", "ids", "cache", "pos", "slot",
+    __slots__ = ("req", "slot_idx", "seq", "ids", "s_total", "pos", "slot",
                  "beat", "chunk", "stall_pos", "tier", "paused",
                  "published")
 
-    def __init__(self, req, slot_idx, seq, ids, cache, slot, chunk):
+    def __init__(self, req, slot_idx, seq, ids, s_total, slot, chunk):
         self.req = req
         self.slot_idx = slot_idx
         self.seq = seq
         self.ids = ids
-        self.cache = cache
+        # Scratch-cache length (the fused-variant compile key). The
+        # cache itself lives in engine._scratch_caches[slot_idx] —
+        # created INSIDE the record executors (_exec_plan/_exec_seed)
+        # so leader and followers materialize it at the same stream
+        # position.
+        self.s_total = s_total
         self.pos = 0  # next prompt offset to feed
         self.slot = slot  # the placeholder occupying slots[slot_idx]
         # Pages already scattered into the pool + inserted into the
@@ -309,6 +314,13 @@ class EngineMetrics:
         # memory planner held back as headroom (0 = planner off).
         self.multihost_processes = 0
         self.planner_headroom_bytes = 0
+        # Dispatch-replay counters (serving/multihost.py; always
+        # present — 0 when single-process): records rank 0 published to
+        # the dispatch log (incl. digests), and CRC divergences the
+        # replay detector raised on this rank (any nonzero value means
+        # the follower refused to enter further collectives).
+        self.replay_records_published = 0
+        self.replay_divergence = 0
         # Prompt tokens actually run through a prefill forward (valid
         # tokens, not bucket padding) — with the prefix cache on, a hit
         # adds only its uncached suffix here.
@@ -449,6 +461,8 @@ class EngineMetrics:
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "multihost_processes": self.multihost_processes,
             "planner_headroom_bytes": self.planner_headroom_bytes,
+            "replay_records_published": self.replay_records_published,
+            "replay_divergence": self.replay_divergence,
             # Always present — 0, never absent (the PR-5 counter
             # convention): dashboards must not see the speculation
             # gauge appear and disappear with traffic.
@@ -669,6 +683,14 @@ class LLMEngine:
                     host_budget_mb=self.ecfg.kv_host_budget_mb,
                     spill_dir=self.ecfg.kv_spill_dir, put=self._put,
                     max_batch_pages=self.max_pages)
+                # Under multihost the pager publishes its pool_to_pages/
+                # pages_to_pool launches (pager_out/pager_in records)
+                # through the leader's dispatch log; followers replay
+                # them from their own per-host cold store (_exec_pager_*)
+                # so every rank enters the same gather/scatter programs
+                # in the same order.
+                self.kv_pager.mh_log = (self._mh_log if self._mh_leader
+                                        else None)
                 self.prefix_cache = PagedPrefixCache(
                     self.allocator, ps, cap, self.kv_pager,
                     lambda: self.pool)
@@ -687,6 +709,16 @@ class LLMEngine:
                 self.memory_plan.headroom_bytes)
         if self._mh_log is not None:
             self.metrics.multihost_processes = jax.process_count()
+            if self._mh_leader:
+                # Count every record rank 0 publishes (incl. digests) —
+                # followers compare it against their consumed-stream
+                # position when debugging a divergence.
+                m = self.metrics
+
+                def _on_publish(kind: str) -> None:
+                    m.replay_records_published += 1
+
+                self._mh_log.on_publish = _on_publish
         if self.kv_pager is not None:
             self.metrics.kv_pager_stats = self.kv_pager.stats
         # Flight recorder (serving/flight.py): one beat record per
@@ -798,6 +830,22 @@ class LLMEngine:
         self._fetch_box: Dict[str, Any] = {}
         self._reader: Optional[threading.Thread] = None
         self._long_prefills: List[_LongPrefill] = []
+        # Scratch KVCache registry, slot_idx -> KVCache: the device half
+        # of a _LongPrefill, created INSIDE the record executors
+        # (_exec_plan lazily / _exec_seed) so leader and followers
+        # materialize it at the same position in the dispatch stream.
+        self._scratch_caches: Dict[int, Any] = {}
+        # Last rider-chunk results, slot_idx -> (chunk_logits, tok0):
+        # stashed by _exec_plan on every rank, consumed by _exec_commit
+        # — the commit record then never has to carry device arrays.
+        self._chunk_res: Dict[int, Any] = {}
+        # Follower-side per-host cold page store for pager replay,
+        # cold_key -> (local codes, local scales|None), plus the
+        # sharding/index metadata needed to reassemble global arrays
+        # (leader-side state lives in KVPager; followers never run the
+        # pager's eviction policy, they replay its launches).
+        self._mh_cold: Dict[int, Any] = {}
+        self._mh_cold_meta: Optional[dict] = None
         # Reader beat: landed-decode-block counter; paces chunked
         # prefills to one chunk per block while streams are live.
         self._beat = 0
@@ -1409,20 +1457,6 @@ class LLMEngine:
                     f"{max_prompt} (page capacity minus one generated "
                     f"token)")
             req.prompt_ids = req.prompt_ids[-max_prompt:]
-        if self._mh_log is not None:
-            # Chunked long-prefill dispatches (scratch KVCache + scatter)
-            # are not in the multihost replay protocol yet; cap prompts
-            # at the largest bucket so the dispatch stream stays inside
-            # the two replayed record kinds.
-            bucket_cap = max(self.ecfg.prefill_buckets)
-            if len(req.prompt_ids) > bucket_cap:
-                if not req.truncate_prompt:
-                    raise PromptTooLongError(
-                        f"prompt is {len(req.prompt_ids)} tokens; "
-                        f"engine.multihost caps prompts at the largest "
-                        f"prefill bucket ({bucket_cap}) — chunked long "
-                        f"prefills are not replayed across hosts yet")
-                req.prompt_ids = req.prompt_ids[-bucket_cap:]
         with self._lock:
             self.waiting.append(req)
             self._tier_depth(req, +1)
@@ -1547,8 +1581,7 @@ class LLMEngine:
                 w *= 2
             row = np.zeros((w,), np.int32)  # padding -> sink page 0
             row[: len(batch)] = [n.page for n in batch]
-            got, got_s = engine_model.pool_to_pages(self.pool,
-                                                    self._put(row))
+            got, got_s = self._exec_pages_out(dict(row=row))
             # Pool pages are sharded on kv-heads (tensor axis): under a
             # multi-host mesh this host only owns its shard, so the
             # gather must assemble addressable shards (and fail with
@@ -1599,7 +1632,7 @@ class LLMEngine:
             w *= 2
         row = np.zeros((w,), np.int32)  # padding -> sink page 0
         row[:n_pages] = [n.page for n in dev[lo:hi]]
-        got, got_s = engine_model.pool_to_pages(self.pool, self._put(row))
+        got, got_s = self._exec_pages_out(dict(row=row))
         return (got[:n_pages],
                 None if got_s is None else got_s[:n_pages],
                 hi * self.pool.page_size)
@@ -1633,11 +1666,10 @@ class LLMEngine:
             covered = min(lp.pos // ps, n_full)
             done = max(lp.published, lp.seq.n_shared)
             if covered > done:
-                S_total = lp.cache.k.shape[-2]
-                row = np.zeros((S_total // ps,), np.int32)  # sink 0
+                row = np.zeros((lp.s_total // ps,), np.int32)  # sink 0
                 row[done:covered] = lp.seq.pages[done:covered]
-                self.pool = engine_model.cache_to_pool(
-                    self.pool, lp.cache, self.cfg, self._put(row))
+                self._exec_publish_pages(
+                    dict(slot=np.int32(lp.slot_idx), row=row))
             if covered > lp.published:
                 self.prefix_cache.insert(ids[: covered * ps],
                                          lp.seq.pages[:covered])
@@ -1701,6 +1733,14 @@ class LLMEngine:
                 f"import window starts at page {first} but only "
                 f"{have} pages of the prefix are resident — a chunk "
                 "gap (an earlier window failed or was evicted)")
+        if self._mh_log is not None and not isinstance(codes, np.ndarray):
+            # Device-path import under multihost would stage through a
+            # device-side scatter (a collective launch followers can't
+            # replay) and its bytes couldn't ride the dispatch record;
+            # bounce through the host so the record is self-contained.
+            codes = np.asarray(codes)
+            if scales is not None:
+                scales = np.asarray(scales)
         device = not isinstance(codes, np.ndarray)
         t0 = time.perf_counter()
         m = n - have
@@ -1721,7 +1761,8 @@ class LLMEngine:
             if device:
                 # Stage the pad on device and move straight to this
                 # engine's placement — no host round trip, the whole
-                # point of the fast path.
+                # point of the fast path (single-process only; the
+                # multihost bounce above forced the host path).
                 buf = jnp.zeros((w,) + tuple(codes.shape[1:]),
                                 codes.dtype).at[:m].set(
                                     codes[have - first: n - first])
@@ -1734,17 +1775,16 @@ class LLMEngine:
                     buf = jax.device_put(buf, self._replicated)
                     if sbuf is not None:
                         sbuf = jax.device_put(sbuf, self._replicated)
+                self._exec_pages_in(dict(row=row), buf=buf, sbuf=sbuf)
             else:
                 hbuf = np.zeros((w,) + codes.shape[1:], codes.dtype)
                 hbuf[:m] = codes[have - first: n - first]
-                buf = self._put(hbuf)
-                sbuf = None
+                rec = dict(row=row, codes=hbuf)
                 if scales is not None:
                     hs = np.zeros((w,) + scales.shape[1:], np.float32)
                     hs[:m] = scales[have - first: n - first]
-                    sbuf = self._put(hs)
-            self.pool = engine_model.pages_to_pool(
-                self.pool, buf, sbuf, self._put(row))
+                    rec["scales"] = hs
+                self._exec_pages_in(rec)
             # The leading `have` chunks are guaranteed present (just
             # re-verified, nothing evicts between here and insert on
             # this thread), so insert dedups them — their payloads
@@ -2392,27 +2432,10 @@ class LLMEngine:
         if self._debug_timing:
             _LOG.info("[timing] prefill bucket=%d n=%d padded=%d",
                       bucket, n, N)
-        if self._mh_log is not None and self._mh_leader:
-            # Publish BEFORE launching: cross-process collectives pair
-            # by launch order, so followers must enter this same jitted
-            # prefill as their very next dispatch.
-            self._mh_log.publish(
-                "prefill", tokens=tokens, lengths=lengths, rows=rows,
-                temps=temps, top_ps=top_ps, top_ks=top_ks, idxs=idxs,
-                flags=np.asarray(flags))
-        toks, self.pool = engine_model.prefill_batch_step(
-            self.params, self.cfg, self.pool, self._put(tokens),
-            self._put(lengths), self._put(rows), self._put(temps),
-            self._put(top_ps), self._put(top_ks), self._next_key(),
-            self.use_pallas, sampling_flags=flags, mesh=self.mesh)
-        # Scatter the first-tokens into the device buffer (padding rows'
-        # out-of-bounds indices are dropped on device).
-        self._last_tokens = engine_model.set_last_tokens(
-            self._last_tokens, self._put(idxs), toks)
-        if self._spec_k:
-            self._history, self._dev_lengths = engine_model.set_history_rows(
-                self._history, self._dev_lengths, self._put(idxs),
-                self._put(tokens), self._put(lengths), toks)
+        toks = self._exec_prefill(dict(
+            tokens=tokens, lengths=lengths, rows=rows, temps=temps,
+            top_ps=top_ps, top_ks=top_ks, idxs=idxs,
+            flags=np.asarray(flags)))
         metas = []
         for req, slot_idx, seq, ids in entries:
             span = ManualSpan("engine.generate", context=req.trace_context,
@@ -2459,17 +2482,16 @@ class LLMEngine:
         NOTE: a COLD S_total shape compiles on the scheduler thread —
         warm the variants at boot via warmup(long_prompts=True) when
         long prompts are expected in live traffic."""
-        from generativeaiexamples_tpu.models.llama import KVCache
-
         chunk = self.buckets[-1]
         S_total = -(-len(ids) // chunk) * chunk
-        # Model dtype, NOT kv dtype: llama.forward's scatter writes
-        # model-dtype k/v; cache_to_pool casts once at the page write.
-        cache = self._place_scratch_cache(
-            KVCache.zeros(self.cfg, 1, max_len=S_total))
+        # No device allocation here: the scratch cache materializes
+        # inside _exec_plan when the first chunk record executes (its
+        # `fresh` flag), so leader and followers build it at the same
+        # position in the dispatch stream.
         placeholder.prefilling = True
         self._long_prefills.append(
-            _LongPrefill(req, slot_idx, seq, ids, cache, placeholder, chunk))
+            _LongPrefill(req, slot_idx, seq, ids, S_total, placeholder,
+                         chunk))
 
     # -- prefix cache ------------------------------------------------------
 
@@ -2625,19 +2647,17 @@ class LLMEngine:
                 s_total = -(-plen // chunk) * chunk
             row = np.zeros((s_total // ps,), np.int32)
             row[: len(pages)] = pages
-            cache = engine_model.pool_to_cache(  # graftlint: ignore[GL701] prefix_cache is rejected by validate_multihost_profile, so this lane never runs on a multihost leader
-                self.pool, self.cfg, self._put(row),
-                self._put(np.int32(m)))
-            # Same placement as warmup's scratch caches — jit
-            # specializes on input sharding, so a differently-placed
-            # live cache would recompile prefill_chunk_step on the
-            # scheduler thread (no-op off-mesh and when GSPMD already
-            # chose the warmed placement).
-            cache = self._place_scratch_cache(cache)
+            # The gather AND the warmup-matched placement happen inside
+            # the seed executor, so followers replay them at the same
+            # stream position (the page-index row rides the record —
+            # followers never see the radix tree that produced it).
+            self._exec_seed(dict(slot=np.int32(slot_idx), row=row,
+                                 m=np.int32(m), s_total=np.int32(s_total)))
         finally:
             self._release_hit_pin((pages, m))
         placeholder.prefilling = True
-        lp = _LongPrefill(req, slot_idx, seq, ids, cache, placeholder, chunk)
+        lp = _LongPrefill(req, slot_idx, seq, ids, s_total, placeholder,
+                          chunk)
         lp.pos = m
         self._long_prefills.append(lp)
 
@@ -2663,9 +2683,11 @@ class LLMEngine:
                 # Slot was failed/retired (e.g. _fail_active) while
                 # prefilling; the seq was released by _finish.
                 self._long_prefills.remove(lp)
+                self._drop_scratch(lp.slot_idx)
                 continue
             if lp.req.cancelled:
                 self._long_prefills.remove(lp)
+                self._drop_scratch(lp.slot_idx)
                 self._finish(lp.slot_idx, "cancelled")
                 continue
             if lp.paused:
@@ -2683,7 +2705,7 @@ class LLMEngine:
                 continue
             lp.beat = self._beat
             chunk = lp.chunk
-            s_total = lp.cache.k.shape[-2]
+            s_total = lp.s_total
             n_chunks = max(1, self.ecfg.prefill_chunks_per_block) \
                 if decoding else 1
             try:
@@ -2707,31 +2729,27 @@ class LLMEngine:
                                         in self._warm_sample_chunks))
                     # A rider-only plan (decode_k=0): the idle/fallback
                     # lane's chunk dispatch goes through the same
-                    # plan_step entry point as every other device step.
-                    kw = dict(cache=lp.cache, chunk_tokens=self._put(tok),
-                              chunk_valid=self._put(np.int32(len(part))),
-                              use_pallas=self.use_pallas, mesh=self.mesh)
+                    # plan-record executor as every other device step.
+                    rec = engine_model.plan_to_record(
+                        engine_model.StepPlan(rider_width=width,
+                                              rider_s_total=s_total,
+                                              rider_sample=fuse_sample))
+                    rec.update(slot=np.int32(lp.slot_idx),
+                               chunk_tokens=tok,
+                               chunk_valid=np.int32(len(part)),
+                               fresh=np.bool_(lp.pos == 0))
                     if fuse_sample:
                         req = lp.req
                         greedy = req.temperature <= 0.0
-                        flags = (True, False, False) if greedy \
-                            else (False, True, True)
-                        kw.update(
-                            last_tokens=self._last_tokens,
-                            slot_idx=self._put(np.int32(lp.slot_idx)),
-                            temperature=req.temperature, top_p=req.top_p,
-                            top_k=req.top_k, rng=self._next_key(),
-                            sampling_flags=flags)
-                    res = engine_model.plan_step(  # graftlint: ignore[GL701] submit() caps multihost prompts at the largest bucket, so chunked long prefills never launch on a leader
-                        self.params, self.cfg,
-                        engine_model.StepPlan(rider_width=width,
-                                              rider_s_total=s_total,
-                                              rider_sample=fuse_sample),
-                        **kw)
-                    lp.cache = res["cache"]
-                    logits = res.get("chunk_logits")
+                        rec.update(
+                            r_temp=np.float32(req.temperature),
+                            r_top_p=np.float32(req.top_p),
+                            r_top_k=np.int32(req.top_k),
+                            r_flags=np.asarray(
+                                (True, False, False) if greedy
+                                else (False, True, True)))
+                    self._exec_plan(rec)
                     if fuse_sample:
-                        self._last_tokens = res["last_tokens"]
                         self.metrics.fused_sample_dispatches += 1
                     lp.pos += len(part)
                     self.metrics.prefill_tokens += len(part)
@@ -2742,15 +2760,23 @@ class LLMEngine:
                             tier=tier_id(lp.tier), a=float(len(part)))
                     if lp.pos >= len(lp.ids):
                         self._long_prefills.remove(lp)
-                        self._finish_long_prefill(lp, logits,
-                                                  tok0=res.get("tok0"))
+                        self._finish_long_prefill(lp)
                         break
             except Exception:
                 _LOG.exception("chunked prefill failed")
                 self._long_prefills.remove(lp)
+                self._drop_scratch(lp.slot_idx)
                 self._fail_request(lp.req, lp.slot_idx, lp.seq)
             did = True
         return did
+
+    def _drop_scratch(self, slot_idx: int) -> None:
+        """Leader-side registry cleanup for a long prefill that ends
+        WITHOUT a commit record (cancel / slot failure). Followers keep
+        their stale entry until the slot's next `fresh` plan record
+        recreates the cache — the stale bytes are never read."""
+        self._scratch_caches.pop(slot_idx, None)
+        self._chunk_res.pop(slot_idx, None)
 
     def _pick_chunk_width(self, n: int, chunk: int, s_total: int) -> int:
         """Dispatch width for a chunk of n valid tokens: the smallest
@@ -2794,7 +2820,7 @@ class LLMEngine:
         prefill behind traffic that never launches a block."""
         if not self._fused_width or lp.pos >= len(lp.ids):
             return False
-        s_total = lp.cache.k.shape[-2]
+        s_total = lp.s_total
         if s_total < self._fused_width:
             return False
         warm = self._warm_spec_fused if self._spec_k else self._warm_fused
@@ -2827,24 +2853,19 @@ class LLMEngine:
                 self.metrics.prefill_stall_beats += 1
             lp.stall_pos = lp.pos
 
-    def _finish_long_prefill(self, lp: "_LongPrefill", logits,  # graftlint: ignore[GL701] whole fn is the chunked-prefill finisher; multihost submit() caps prompts at the largest bucket so it never runs on a leader
-                             tok0=None) -> None:
-        """Last chunk fed: scatter the scratch cache into the page pool,
-        sample the first token on device, and open the slot for decode.
-
-        tok0 is non-None when the finishing chunk rode the fused-
-        sampling tail (rider_sample plan): the sample + last_tokens
-        scatter already happened inside that dispatch, so only the
-        host-side bookkeeping remains here. Otherwise the first token
-        is sampled now — in ONE merged dispatch (sample_token_into)
-        under engine.fused_sampling, or the legacy sample_token +
-        set_last_token pair with the knob off (same math and key
-        stream either way — CPU CI pins byte-identical streams; the
-        knob only changes dispatch count)."""
+    def _finish_long_prefill(self, lp: "_LongPrefill") -> None:
+        """Last chunk fed: ONE commit record finishes the prefill —
+        scatter the scratch cache into the page pool, sample the first
+        token on device (unless the finishing chunk already rode the
+        fused-sampling tail — _exec_plan stashed its tok0 in
+        _chunk_res), seed the speculative history row — then open the
+        slot for decode. All device work lives in _exec_commit so
+        followers replay it from the record alone; only the host-side
+        slot/tree bookkeeping stays here."""
         from generativeaiexamples_tpu.obs.tracing import ManualSpan
 
         ps = self.pool.page_size
-        S_total = lp.cache.k.shape[-2]
+        S_total = lp.s_total
         row = np.zeros((S_total // ps,), np.int32)  # padding -> sink 0
         row[:len(lp.seq.pages)] = lp.seq.pages
         # Pages adopted read-only from the prefix cache must never be
@@ -2856,26 +2877,21 @@ class LLMEngine:
         sunk = max(lp.seq.n_shared, lp.published)
         if sunk:
             row[:sunk] = 0
-        self.pool = engine_model.cache_to_pool(self.pool, lp.cache, self.cfg,
-                                               self._put(row))
-        self._insert_prefix(lp.ids, lp.seq)
         req = lp.req
         greedy = req.temperature <= 0.0
         flags = (True, False, False) if greedy else (False, True, True)
-        if tok0 is None:
-            if self._fused_sampling:
-                tok0, self._last_tokens = engine_model.sample_token_into(
-                    self._last_tokens, self._put(np.int32(lp.slot_idx)),
-                    logits, req.temperature, req.top_p, req.top_k,
-                    self._next_key(), *flags)
-                self.metrics.fused_sample_dispatches += 1
-            else:
-                tok0 = engine_model.sample_token(
-                    logits, req.temperature, req.top_p, req.top_k,
-                    self._next_key(), *flags)
-                self._last_tokens = engine_model.set_last_token(
-                    self._last_tokens, self._put(np.int32(lp.slot_idx)),
-                    tok0)
+        # Peek (don't pop — _exec_commit owns the pop) whether the
+        # final chunk already sampled tok0 on device.
+        _, tok0_prev = self._chunk_res.get(lp.slot_idx, (None, None))
+        rec = dict(slot=np.int32(lp.slot_idx), row=row,
+                   sampled=np.bool_(tok0_prev is not None),
+                   temp=np.float32(req.temperature),
+                   top_p=np.float32(req.top_p),
+                   top_k=np.int32(req.top_k), flags=np.asarray(flags))
+        if self._spec_k:
+            rec["h_ids"] = np.asarray(lp.ids, np.int32)
+        tok0 = self._exec_commit(rec)
+        self._insert_prefix(lp.ids, lp.seq)
         span = ManualSpan("engine.generate", context=req.trace_context,
                           attributes={"prompt_tokens": len(lp.ids),
                                       "chunked_prefill": True,
@@ -2883,14 +2899,6 @@ class LLMEngine:
         slot = _Slot(req, lp.seq, StreamDetokenizer(self.tokenizer),
                      span=span)
         self.slots[lp.slot_idx] = slot
-        if self._spec_k:
-            row = np.zeros((1, self.ecfg.max_seq_len), np.int32)
-            row[0, :len(lp.ids)] = lp.ids
-            self._history, self._dev_lengths = engine_model.set_history_rows(
-                self._history, self._dev_lengths,
-                self._put(np.asarray([lp.slot_idx], np.int32)),
-                self._put(row),
-                self._put(np.asarray([len(lp.ids)], np.int32)), tok0[None])
         # Same early first-token path as bucketed prefill.
         try:
             tok0.copy_to_host_async()
@@ -2951,7 +2959,8 @@ class LLMEngine:
         build the batch state, select the widest warmed StepPlan
         (decode block + optional spec-verify width + optional prefill
         rider — _select_plan) and lower it through ONE
-        engine_model.plan_step dispatch (_dispatch_plan). Sampling /
+        engine_model.plan_step dispatch (the `plan` record executor,
+        _exec_plan — published to the multihost log first). Sampling /
         verification happens on device and tokens chain device-side,
         so this returns without any host<->device sync; results are
         consumed later by _process_block.
@@ -3096,17 +3105,29 @@ class LLMEngine:
             and bool(all(temps[i] <= 0.0 for i in active)))
         flags = (True, False, False) if all_greedy else (False, True, True)
         plan, lp = self._select_plan(K, spec_mode)
-        if self._mh_log is not None and self._mh_leader:
-            # Publish BEFORE launching (collectives pair by launch
-            # order). K alone reproduces the plan on the follower: the
-            # multihost profile pins spec_mode off and step_plans off,
-            # so _select_plan(K, False) is a pure function of K.
-            self._mh_log.publish(
-                "decode", k=np.int32(K), tables=tables, lengths=lengths,
-                active_mask=active_mask, temps=temps, top_ps=top_ps,
-                top_ks=top_ks, flags=np.asarray(flags))
-        res = self._dispatch_plan(plan, lp, tables, lengths, active_mask,
-                                  temps, top_ps, top_ks, flags)
+        # The record carries the WHOLE plan lattice point plus every
+        # host scalar the launch consumes: followers rebuild the exact
+        # StepPlan from it (engine_model.plan_from_record) instead of
+        # re-deriving it from scheduler state they don't have — only
+        # the scheduler's OUTPUTS cross the wire (the GL703 invariant).
+        rec = engine_model.plan_to_record(plan)
+        rec.update(tables=tables, lengths=lengths,
+                   active_mask=active_mask, temps=temps, top_ps=top_ps,
+                   top_ks=top_ks, flags=np.asarray(flags))
+        n_part = 0
+        if plan.rider_width:
+            part = lp.ids[lp.pos:lp.pos + plan.rider_width]
+            n_part = len(part)
+            # Publishing the reused staging buffer is safe: the record
+            # serializes (np.savez) at publish time, before any reuse.
+            tok = self._chunk_buf(plan.rider_width)
+            tok[0, :n_part] = part
+            rec.update(slot=np.int32(lp.slot_idx), chunk_tokens=tok,
+                       chunk_valid=np.int32(n_part),
+                       fresh=np.bool_(lp.pos == 0))
+        res = self._exec_plan(rec)
+        if plan.rider_width:
+            self._rider_bookkeeping(lp, n_part)
         self.metrics.decode_steps += K
         self.metrics.busy_slots_acc += len(active) * K
         if spec_mode:
@@ -3168,7 +3189,7 @@ class LLMEngine:
                     and not cand.req.cancelled
                     and not cand.paused
                     and cand.pos < len(cand.ids)
-                    and cand.cache.k.shape[-2] >= self._fused_width):
+                    and cand.s_total >= self._fused_width):
                 return cand
         return None
 
@@ -3191,7 +3212,7 @@ class LLMEngine:
         if not spec_state:  # the fallback plan has no rider variant
             cand = self._rider_candidate()
             if cand is not None:
-                s_total = cand.cache.k.shape[-2]
+                s_total = cand.s_total
                 warm = self._warm_spec_fused if spec_k else self._warm_fused
                 # Keyed on _warm_ks (did ANY warmup run), so a warmup
                 # without long_prompts=True — which leaves the fused
@@ -3207,63 +3228,50 @@ class LLMEngine:
             spec_state=spec_state), lp
 
     # graftlint: hot-path
-    def _dispatch_plan(self, plan, lp, tables, lengths, active_mask,
-                       temps, top_ps, top_ks, flags):
-        """Lower the selected StepPlan through engine_model.plan_step —
-        ONE fully async jitted dispatch — and fold the returned state
-        back into the engine (pool / device token chain / speculative
-        state / the rider's scratch cache, counters and pacing beat)."""
-        kw = dict(pool=self.pool, last_tokens=self._last_tokens,
-                  page_tables=self._put(tables),
-                  active=self._put(active_mask),
-                  use_pallas=self.use_pallas, mesh=self.mesh)
-        if plan.spec_k or plan.spec_state:
-            kw.update(history=self._history, dev_lengths=self._dev_lengths)
-        if not plan.spec_k:
-            kw.update(lengths=self._put(lengths),
-                      temperature=self._put(temps),
-                      top_p=self._put(top_ps), top_k=self._put(top_ks),
-                      rng=self._next_key(), sampling_flags=flags)
-        part = None
-        if plan.rider_width:
-            part = lp.ids[lp.pos:lp.pos + plan.rider_width]
-            tok = self._chunk_buf(plan.rider_width)
-            tok[0, :len(part)] = part
-            kw.update(cache=lp.cache, chunk_tokens=self._put(tok),
-                      chunk_valid=self._put(np.int32(len(part))))
-        res = engine_model.plan_step(self.params, self.cfg, plan, **kw)
-        self.pool = res["pool"]
-        self._last_tokens = res["last_tokens"]
-        if plan.spec_k or plan.spec_state:
-            self._dev_lengths = res["dev_lengths"]
-            self._history = res["history"]
-        if plan.rider_width:
-            lp.cache = res["cache"]
-            lp.pos += len(part)
-            lp.beat = self._beat  # the rider consumed this beat's chunk
-            self.metrics.fused_steps += 1
-            self.metrics.fused_prefill_tokens += len(part)
-            # Real (unpadded) prompt tokens only — the rider's fixed-
-            # width padding must not inflate the prefill meter.
-            self.metrics.prefill_tokens += len(part)
-            if self.flight.enabled:
-                self.flight.record_event(
-                    EV_PREFILL_CHUNK, time.perf_counter(),
-                    rid=lp.req.request_id, tier=tier_id(lp.tier),
-                    a=float(len(part)), b=1.0)  # b=1: fused rider
-            if lp.pos >= len(lp.ids):
-                self._long_prefills.remove(lp)
-                self._finish_long_prefill(lp, res["chunk_logits"])
-        return res
+    def _rider_bookkeeping(self, lp: "_LongPrefill",
+                           n_part: int) -> None:
+        """Leader-side bookkeeping after a fused-rider plan record
+        executed: advance the prefill cursor, meter the chunk, and
+        commit the prefill when the prompt is fully fed. Device state
+        was already folded by _exec_plan."""
+        lp.pos += n_part
+        lp.beat = self._beat  # the rider consumed this beat's chunk
+        self.metrics.fused_steps += 1
+        self.metrics.fused_prefill_tokens += n_part
+        # Real (unpadded) prompt tokens only — the rider's fixed-
+        # width padding must not inflate the prefill meter.
+        self.metrics.prefill_tokens += n_part
+        if self.flight.enabled:
+            self.flight.record_event(
+                EV_PREFILL_CHUNK, time.perf_counter(),
+                rid=lp.req.request_id, tier=tier_id(lp.tier),
+                a=float(n_part), b=1.0)  # b=1: fused rider
+        if lp.pos >= len(lp.ids):
+            self._long_prefills.remove(lp)
+            self._finish_long_prefill(lp)
 
-    # -- multihost dispatch replay (serving/multihost.run_follower) --------
+    # -- dispatch-record executors (multihost replay vocabulary) -----------
+    #
+    # Every scheduler-reachable collective launch lives in one of the
+    # _exec_* methods below. Each builds its device inputs FROM THE
+    # RECORD alone, publishes the record right before launching (leader
+    # only — followers run the same executor via _mh_replay_table with
+    # _mh_leader False), and folds the returned device state back into
+    # the engine. Leader-only state (slots, radix tree, allocator, QoS)
+    # never enters an executor: only its outputs — launch order and
+    # host scalars — cross the wire (the GL703 invariant).
 
-    def _replay_prefill(self, rec: Dict[str, np.ndarray]) -> None:
-        """Follower half of _prefill_group's device dispatch: the same
-        engine_model launches, driven by the leader's record — no
-        admission, no slots, no host readback. The RNG stream stays in
-        lockstep because both ranks call _next_key() exactly once per
-        replayed dispatch (and ran an identical warmup)."""
+    def _exec_prefill(self, rec: Dict[str, Any]):
+        """Execute one `prefill` record: the batched prefill forward +
+        on-device sampling, the first-token scatter, and (speculative
+        engines) the history-row seed. The RNG stream stays in lockstep
+        because every rank draws exactly one key here."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            # Publish BEFORE launching: cross-process collectives pair
+            # by launch order, so followers must enter this same jitted
+            # prefill as their very next dispatch.
+            log.publish("prefill", **rec)
         flags = tuple(bool(f) for f in rec["flags"])
         toks, self.pool = engine_model.prefill_batch_step(
             self.params, self.cfg, self.pool, self._put(rec["tokens"]),
@@ -3271,19 +3279,309 @@ class LLMEngine:
             self._put(rec["temps"]), self._put(rec["top_ps"]),
             self._put(rec["top_ks"]), self._next_key(), self.use_pallas,
             sampling_flags=flags, mesh=self.mesh)
+        # Scatter the first-tokens into the device buffer (padding rows'
+        # out-of-bounds indices are dropped on device).
         self._last_tokens = engine_model.set_last_tokens(
             self._last_tokens, self._put(rec["idxs"]), toks)
+        if self._spec_k:
+            self._history, self._dev_lengths = \
+                engine_model.set_history_rows(
+                    self._history, self._dev_lengths,
+                    self._put(rec["idxs"]), self._put(rec["tokens"]),
+                    self._put(rec["lengths"]), toks)
+        return toks
 
-    def _replay_decode(self, rec: Dict[str, np.ndarray]) -> None:
-        """Follower half of _dispatch_decode's device dispatch: K alone
-        reproduces the StepPlan (the multihost profile pins speculation,
-        step plans and the fused rider off), and _dispatch_plan folds
-        pool/_last_tokens forward exactly as on the leader."""
-        plan, lp = self._select_plan(int(rec["k"]), False)
-        self._dispatch_plan(
-            plan, lp, rec["tables"], rec["lengths"], rec["active_mask"],
-            rec["temps"], rec["top_ps"], rec["top_ks"],
-            tuple(bool(f) for f in rec["flags"]))
+    def _exec_plan(self, rec: Dict[str, Any]):
+        """Execute one `plan` record — EVERY plan_step lattice point
+        (decode / spec verify / tree / fused rider / fused-sample
+        chunk) lowers through here as ONE jitted dispatch. The record
+        is self-describing: the full StepPlan plus every host scalar
+        the launch consumes (page tables, sampling params, the rider's
+        chunk tokens), so a follower rebuilds the identical program
+        without any scheduler state."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            # Publish BEFORE launching (collectives pair by launch
+            # order).
+            log.publish("plan", **rec)
+        plan = engine_model.plan_from_record(rec)
+        kw = dict(use_pallas=self.use_pallas, mesh=self.mesh)
+        if plan.decode_k:
+            kw.update(pool=self.pool, last_tokens=self._last_tokens,
+                      page_tables=self._put(rec["tables"]),
+                      active=self._put(rec["active_mask"]))
+            if plan.spec_k or plan.spec_state:
+                kw.update(history=self._history,
+                          dev_lengths=self._dev_lengths)
+            if not plan.spec_k:
+                kw.update(lengths=self._put(rec["lengths"]),
+                          temperature=self._put(rec["temps"]),
+                          top_p=self._put(rec["top_ps"]),
+                          top_k=self._put(rec["top_ks"]),
+                          rng=self._next_key(),
+                          sampling_flags=tuple(bool(f)
+                                               for f in rec["flags"]))
+        if plan.rider_width:
+            slot = int(rec["slot"])
+            cache = self._scratch_caches.get(slot)
+            if cache is None or bool(rec["fresh"]):
+                # First chunk of this prefill (or the slot's previous
+                # occupant was dropped leader-side without a commit):
+                # materialize the scratch cache HERE, at the record's
+                # stream position, so every rank builds it from the
+                # same zeros at the same point in the launch order.
+                # Model dtype, NOT kv dtype: llama.forward's scatter
+                # writes model-dtype k/v; cache_to_pool casts once at
+                # the page write.
+                from generativeaiexamples_tpu.models.llama import KVCache
+
+                cache = self._place_scratch_cache(
+                    KVCache.zeros(self.cfg, 1,
+                                  max_len=plan.rider_s_total))
+                self._chunk_res.pop(slot, None)
+            kw.update(cache=cache,
+                      chunk_tokens=self._put(rec["chunk_tokens"]),
+                      chunk_valid=self._put(
+                          np.int32(int(rec["chunk_valid"]))))
+        if plan.rider_sample:
+            kw.update(last_tokens=self._last_tokens,
+                      slot_idx=self._put(np.int32(int(rec["slot"]))),
+                      temperature=float(rec["r_temp"]),
+                      top_p=float(rec["r_top_p"]),
+                      top_k=int(rec["r_top_k"]),
+                      rng=self._next_key(),
+                      sampling_flags=tuple(bool(f)
+                                           for f in rec["r_flags"]))
+        res = engine_model.plan_step(self.params, self.cfg, plan, **kw)
+        if "pool" in res:
+            self.pool = res["pool"]
+        if plan.decode_k or plan.rider_sample:
+            self._last_tokens = res["last_tokens"]
+        if plan.spec_k or plan.spec_state:
+            self._dev_lengths = res["dev_lengths"]
+            self._history = res["history"]
+        if plan.rider_width:
+            slot = int(rec["slot"])
+            self._scratch_caches[slot] = res["cache"]
+            # The finishing chunk's logits/tok0 feed the commit record's
+            # sample — stashed per-slot on BOTH ranks so the commit
+            # never has to carry device arrays over the wire.
+            self._chunk_res[slot] = (res.get("chunk_logits"),
+                                     res.get("tok0"))
+        return res
+
+    def _exec_seed(self, rec: Dict[str, Any]) -> None:
+        """Execute one `seed` record — a prefix-cache hit's scratch
+        seeding: ONE pool_to_cache gather of the matched pages into a
+        fresh scratch cache, registered under the slot. The page-index
+        row rides the record, so followers launch the identical gather
+        without reproducing the leader's radix-tree match."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            log.publish("seed", **rec)
+        slot = int(rec["slot"])
+        cache = engine_model.pool_to_cache(
+            self.pool, self.cfg, self._put(rec["row"]),
+            self._put(np.int32(int(rec["m"]))))
+        # Same placement as warmup's scratch caches — jit specializes
+        # on input sharding, so a differently-placed live cache would
+        # recompile prefill_chunk_step on the scheduler thread.
+        self._scratch_caches[slot] = self._place_scratch_cache(cache)
+        self._chunk_res.pop(slot, None)
+
+    def _exec_commit(self, rec: Dict[str, Any]):
+        """Execute one `commit` record — the chunked-prefill finish:
+        ONE cache_to_pool scatter of the scratch cache (already-
+        published and adopted rows sunk to page 0 by the leader-built
+        row), the first-token sample (sample_token_into under
+        engine.fused_sampling, the legacy pair otherwise; skipped when
+        the finishing chunk already rode the fused-sampling tail), and
+        the speculative history-row seed. Consumes the slot's registry
+        entries on every rank. Returns the first token's device
+        array."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            log.publish("commit", **rec)
+        slot = int(rec["slot"])
+        cache = self._scratch_caches.pop(slot)
+        logits, tok0 = self._chunk_res.pop(slot, (None, None))
+        self.pool = engine_model.cache_to_pool(
+            self.pool, cache, self.cfg, self._put(rec["row"]))
+        if not bool(rec["sampled"]):
+            flags = tuple(bool(f) for f in rec["flags"])
+            temp = float(rec["temp"])
+            top_p = float(rec["top_p"])
+            top_k = int(rec["top_k"])
+            if self._fused_sampling:
+                tok0, self._last_tokens = engine_model.sample_token_into(
+                    self._last_tokens, self._put(np.int32(slot)),
+                    logits, temp, top_p, top_k, self._next_key(),
+                    *flags)
+                self.metrics.fused_sample_dispatches += 1
+            else:
+                tok0 = engine_model.sample_token(
+                    logits, temp, top_p, top_k, self._next_key(),
+                    *flags)
+                self._last_tokens = engine_model.set_last_token(
+                    self._last_tokens, self._put(np.int32(slot)), tok0)
+        if self._spec_k:
+            ids = np.asarray(rec["h_ids"], np.int32)
+            row = np.zeros((1, self.ecfg.max_seq_len), np.int32)
+            row[0, : ids.shape[0]] = ids
+            self._history, self._dev_lengths = \
+                engine_model.set_history_rows(
+                    self._history, self._dev_lengths,
+                    self._put(np.asarray([slot], np.int32)),
+                    self._put(row),
+                    self._put(np.asarray([ids.shape[0]], np.int32)),
+                    tok0[None])
+        return tok0
+
+    def _exec_pages_out(self, rec: Dict[str, Any]):
+        """Execute one `pages_out` record — a batched pool_to_pages
+        gather (disagg export / pager staging). Launch only: the HOST
+        fetch of the gathered bytes is the caller's business (the
+        leader reads them; a follower discards the device arrays —
+        the launch alone keeps the collective streams paired)."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            log.publish("pages_out", **rec)
+        return engine_model.pool_to_pages(self.pool,
+                                          self._put(rec["row"]))
+
+    def _exec_pages_in(self, rec: Dict[str, Any], buf=None,
+                       sbuf=None) -> None:
+        """Execute one `pages_in` record — ONE pages_to_pool scatter of
+        transferred page bytes (disagg import). The host path carries
+        the padded codes/scales in the record itself so followers
+        rebuild identical device inputs; the device (ICI) path passes
+        prebuilt buffers and only runs single-process
+        (import_prefix_pages bounces device arrays through the host
+        under multihost)."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            log.publish("pages_in", **rec)
+        if buf is None:
+            buf = self._put(rec["codes"])
+            if rec.get("scales") is not None:
+                sbuf = self._put(rec["scales"])
+        self.pool = engine_model.pages_to_pool(self.pool, buf, sbuf,
+                                               self._put(rec["row"]))
+
+    def _exec_publish_pages(self, rec: Dict[str, Any]) -> None:
+        """Execute one `publish_pages` record — the pipelined-disagg
+        seam's partial cache_to_pool scatter: newly completed chunks of
+        an in-flight chunked prefill move into the pool ahead of the
+        finish commit. The scratch cache stays registered (later chunks
+        keep writing it)."""
+        log = self._mh_log
+        if log is not None and self._mh_leader:
+            log.publish("publish_pages", **rec)
+        cache = self._scratch_caches[int(rec["slot"])]
+        self.pool = engine_model.cache_to_pool(
+            self.pool, cache, self.cfg, self._put(rec["row"]))
+
+    def _exec_pager_out(self, rec: Dict[str, Any]) -> None:
+        """Follower half of KVPager.demote (`pager_out` — the leader's
+        publish lives in the pager, right before ITS launch): enter the
+        same pool_to_pages gather, then park THIS RANK's addressable
+        shard slice of the gathered pages in the per-host cold store,
+        keyed by the record's cold keys. Followers never run the
+        pager's eviction policy — they mirror its launches and park
+        their own bytes (each rank's host tier holds only its shard
+        slice)."""
+        from generativeaiexamples_tpu.serving import multihost as mh
+
+        got, got_s = engine_model.pool_to_pages(self.pool,
+                                                self._put(rec["row"]))
+        codes, c_idx = mh.fetch_addressable_slice(
+            got, "pager demote gather (codes)")
+        scales = s_idx = None
+        if got_s is not None:
+            scales, s_idx = mh.fetch_addressable_slice(
+                got_s, "pager demote gather (scales)")
+        if self._mh_cold_meta is None:
+            # Page-batch dim 0 is replicated (only kv-heads shard), so
+            # the per-page local index is the fetch index minus dim 0.
+            self._mh_cold_meta = {
+                "codes_sharding": getattr(got, "sharding", None),
+                "codes_index": c_idx[1:],
+                "scales_sharding": (None if got_s is None else
+                                    getattr(got_s, "sharding", None)),
+                "scales_index": None if s_idx is None else s_idx[1:],
+            }
+        for j in range(int(rec["n"])):
+            self._mh_cold[int(rec["keys"][j])] = (
+                np.ascontiguousarray(codes[j]),
+                None if scales is None
+                else np.ascontiguousarray(scales[j]))
+
+    def _exec_pager_in(self, rec: Dict[str, Any]) -> None:
+        """Follower half of KVPager.promote_into (`pager_in`): rebuild
+        the promoted pages' global device arrays from this rank's cold
+        store (put_local_slice — collective-free, each rank supplies
+        its own shard slice) and enter the same pages_to_pool scatter
+        the leader launched. A missing cold key means the streams
+        diverged — raise by name instead of scattering garbage."""
+        from generativeaiexamples_tpu.serving import multihost as mh
+        from generativeaiexamples_tpu.serving.disagg import page_geometry
+
+        meta = self._mh_cold_meta
+        if meta is None:
+            raise mh.MultihostError(
+                "pager_in record before any pager_out — the follower "
+                "cold store is empty; leader and follower replay "
+                "streams have diverged")
+        row = np.asarray(rec["row"])
+        w = int(row.shape[0])
+        entries = []
+        for j in range(int(rec["n"])):
+            key = int(rec["keys"][j])
+            got = self._mh_cold.get(key)
+            if got is None:
+                raise mh.MultihostError(
+                    f"pager_in references cold key {key} this rank "
+                    "never parked (pager_out) — leader and follower "
+                    "replay streams have diverged")
+            entries.append(got)
+        codes_shape, codes_dtype, scales_shape = page_geometry(self.pool)
+        c_idx = meta["codes_index"]
+        staged = np.zeros(
+            (w,) + tuple(sl.stop - sl.start for sl in c_idx),
+            codes_dtype)
+        for j, (c, _) in enumerate(entries):
+            staged[j] = c
+        buf = mh.put_local_slice(staged, (slice(0, w),) + tuple(c_idx),
+                                 (w,) + codes_shape,
+                                 meta["codes_sharding"])
+        sbuf = None
+        if scales_shape and meta["scales_index"] is not None:
+            s_idx = meta["scales_index"]
+            s_staged = np.zeros(
+                (w,) + tuple(sl.stop - sl.start for sl in s_idx),
+                np.float32)
+            for j, (_, s) in enumerate(entries):
+                s_staged[j] = s
+            sbuf = mh.put_local_slice(
+                s_staged, (slice(0, w),) + tuple(s_idx),
+                (w,) + scales_shape, meta["scales_sharding"])
+        self.pool = engine_model.pages_to_pool(self.pool, buf, sbuf,
+                                               self._put(row))
+
+    def _mh_replay_table(self) -> Dict[str, Any]:
+        """kind -> executor for multihost.run_follower: the full launch
+        vocabulary a leader can publish. Followers call the same
+        executors the leader's scheduler calls (with _mh_leader False,
+        so the publish inside each is skipped)."""
+        return {"prefill": self._exec_prefill,
+                "plan": self._exec_plan,
+                "seed": self._exec_seed,
+                "commit": self._exec_commit,
+                "pages_out": self._exec_pages_out,
+                "pages_in": self._exec_pages_in,
+                "publish_pages": self._exec_publish_pages,
+                "pager_out": self._exec_pager_out,
+                "pager_in": self._exec_pager_in}
 
     def _pick_k(self, bound: int) -> int:
         """Largest dispatchable K <= bound: power-of-two, and (when a

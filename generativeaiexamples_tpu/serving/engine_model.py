@@ -881,8 +881,15 @@ def _spec_verify_loop(params, cfg: LlamaConfig, pool, history, last_tokens,
         last_tokens = jnp.where(active, bonus, last_tokens)
         out_t.append(targets)
         out_c.append(counts)
-    return (jnp.stack(out_t, axis=1), jnp.stack(out_c, axis=1),
-            last_tokens, dev_lengths, history, pool)
+    # The host reads (targets, counts) as the spec decode block, and
+    # last_tokens chains into later dispatches exactly like the plain
+    # path's — same replication pin as decode_multi_step (without it,
+    # a cross-process mesh leaves them tensor-sharded and the
+    # decode-block readback seam rejects the fetch).
+    t_stack, c_stack, last_tokens = _replicate_tokens(
+        mesh, jnp.stack(out_t, axis=1), jnp.stack(out_c, axis=1),
+        last_tokens)
+    return (t_stack, c_stack, last_tokens, dev_lengths, history, pool)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "k",
@@ -971,8 +978,11 @@ def decode_plain_spec_state_multi_step(
         history = history.at[bi, hpos].set(
             jnp.where(active, tokens, history[bi, hpos]))
         dev_lengths = jnp.where(active, dev_lengths + 1, dev_lengths)
-    return (jnp.stack(out_tokens, axis=1), tokens, dev_lengths, history,
-            pool)
+    # Same replication pin as decode_multi_step: the block is
+    # host-read, tokens chain device-side across dispatches.
+    block, tokens = _replicate_tokens(
+        mesh, jnp.stack(out_tokens, axis=1), tokens)
+    return (block, tokens, dev_lengths, history, pool)
 
 
 @functools.partial(jax.jit, donate_argnames=("history", "dev_lengths"))
@@ -1179,7 +1189,11 @@ def fused_decode_prefill_step(
         tokens = jnp.where(active, nxt, tokens)
         out_tokens.append(tokens)
         lengths = jnp.where(active, lengths + 1, lengths)
-    return (jnp.stack(out_tokens, axis=1), tokens, pool, chunk_last, cache)
+    # Same replication pin as decode_multi_step: the block is
+    # host-read, tokens chain device-side across dispatches.
+    block, tokens = _replicate_tokens(
+        mesh, jnp.stack(out_tokens, axis=1), tokens)
+    return (block, tokens, pool, chunk_last, cache)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_steps", "k",
@@ -1383,6 +1397,37 @@ class StepPlan(NamedTuple):
     rider_s_total: int = 0
     spec_state: bool = False
     rider_sample: bool = False
+
+
+def plan_to_record(plan: StepPlan) -> dict:
+    """The plan's multihost wire form: every lattice coordinate as an
+    int32 scalar, so a published `plan` dispatch record is
+    self-describing — followers rebuild the exact StepPlan with
+    `plan_from_record` instead of re-deriving it from scheduler state
+    they don't have (the GL703 invariant)."""
+    import numpy as np
+
+    return {
+        "plan_decode_k": np.int32(plan.decode_k),
+        "plan_spec_k": np.int32(plan.spec_k),
+        "plan_tree": np.int32(plan.tree_branches),
+        "plan_rw": np.int32(plan.rider_width),
+        "plan_rs": np.int32(plan.rider_s_total),
+        "plan_spec_state": np.int32(plan.spec_state),
+        "plan_rider_sample": np.int32(plan.rider_sample),
+    }
+
+
+def plan_from_record(rec: dict) -> StepPlan:
+    """Inverse of `plan_to_record` (follower side)."""
+    return StepPlan(
+        decode_k=int(rec["plan_decode_k"]),
+        spec_k=int(rec["plan_spec_k"]),
+        tree_branches=int(rec["plan_tree"]),
+        rider_width=int(rec["plan_rw"]),
+        rider_s_total=int(rec["plan_rs"]),
+        spec_state=bool(int(rec["plan_spec_state"])),
+        rider_sample=bool(int(rec["plan_rider_sample"])))
 
 
 def plan_step(params, cfg: LlamaConfig, plan: StepPlan, **kw) -> dict:

@@ -146,7 +146,33 @@ class KVPager:
         # the engine passes max_pages so every live width is one of
         # the power-of-two variants warmup() precompiled.
         self.max_batch_pages = max(0, int(max_batch_pages))
-        # Host tier: fixed slabs sized from the budget.
+        # Multihost dispatch log (engine wires it on the LEADER only):
+        # demote/promote publish pager_out/pager_in records BEFORE
+        # their gather/scatter launches so follower ranks enter the
+        # same collectives in the same order (replaying from their own
+        # per-host cold store — serving/multihost.py).
+        self.mh_log = None
+        # Monotone id stamped on each demoted node (node.cold_key):
+        # the wire name followers key their cold store by — slot
+        # numbers are leader-local allocator state and never published.
+        self._next_cold_key = 0
+        # Per-host shard-slice mode, armed at the FIRST demote when
+        # the pool gather's addressable shards cover only a slice of
+        # the page (cross-process tensor sharding): host/disk tiers
+        # then hold THIS RANK's slice and promote reassembles the
+        # global array collective-free (put_local_slice). None until
+        # then; single-process pools never arm it.
+        self._kv_sharding = None
+        self._local_index: Optional[tuple] = None
+        self._scales_sharding = None
+        self._scales_index: Optional[tuple] = None
+        self._global_codes_shape = self.codes_shape
+        self._global_scales_shape = self.scales_shape
+        # Host tier: fixed slabs sized from the budget. The budget is
+        # PER-HOST: in shard-slice mode each rank only parks its own
+        # slice, so the first demote resizes the slabs for the smaller
+        # record (see _arm_slice_mode).
+        self._host_budget_mb = int(host_budget_mb)
         n_host = max(0, int(host_budget_mb) * (1 << 20) // self._rec_bytes)
         self.n_host_slots = n_host
         self._host_codes = np.zeros((n_host,) + self.codes_shape,
@@ -228,20 +254,42 @@ class KVPager:
             w = _pow2(len(batch))
             row = np.zeros((w,), np.int32)  # padding -> sink page 0
             row[:len(batch)] = [n.page for n in batch]
+            # Wire names + publish BEFORE the gather launch (GL701):
+            # followers replay the identical pool_to_pages program from
+            # the record alone — `row` is the leader allocator's
+            # page-index decision, `keys` name each parked page so a
+            # later pager_in can reference it without leaking
+            # leader-local slot numbers. Forced drops are published
+            # too (the launch already happened); followers leak those
+            # entries until shutdown — bounded by the drop counter.
+            for node in batch:
+                node.cold_key = self._next_cold_key
+                self._next_cold_key += 1
+            log = self.mh_log
+            if log is not None:
+                log.publish(
+                    "pager_out", row=row, n=np.int32(len(batch)),
+                    keys=np.asarray([n.cold_key for n in batch],
+                                    np.int64))
             codes, scales = engine_model.pool_to_pages(pool, self._put(row))
             # Blocking device->host fetch BY DESIGN: the demotion
             # barrier (pages are recycled the moment this returns).
             # Routed through the multihost seam helper: pool pages are
-            # tensor-sharded, so a cross-process mesh must assemble
-            # addressable shards or fail naming this seam (the
-            # multihost profile disables the pager for now).
+            # tensor-sharded, so under a cross-process mesh each rank
+            # fetches only its ADDRESSABLE SLICE of the page and the
+            # host/disk tiers go per-host (slice mode, armed below).
             from generativeaiexamples_tpu.serving.multihost import (
-                fetch_addressable)
+                fetch_addressable_slice)
 
-            fetched = fetch_addressable(codes, "kv-pager demote gather")
-            fetched_s = (fetch_addressable(
+            fetched, f_idx = fetch_addressable_slice(
+                codes, "kv-pager demote gather")
+            fetched_s, fs_idx = (fetch_addressable_slice(
                 scales, "kv-pager demote gather (scales)")
-                if scales is not None else None)
+                if scales is not None else (None, None))
+            if (self._kv_sharding is None
+                    and fetched.shape[1:] != tuple(self._global_codes_shape)):
+                self._arm_slice_mode(codes, f_idx, scales, fs_idx,
+                                     fetched, fetched_s)
             with self._lock:
                 stored = 0
                 for i, node in enumerate(batch):
@@ -278,6 +326,46 @@ class KVPager:
         self._spill_nodes[slot] = node
         return True
 
+    def _arm_slice_mode(self, codes, f_idx, scales, fs_idx,
+                        fetched: np.ndarray,
+                        fetched_s: Optional[np.ndarray]) -> None:
+        """First demote under a cross-process mesh: this rank's
+        addressable shards cover only a slice of each page. Rebase the
+        pager's record geometry on the LOCAL slice (host/disk tiers
+        are per-host from here on) and remember the gather output's
+        sharding + this rank's index so promote can reassemble the
+        global array collective-free via put_local_slice. Runs before
+        any _store_locked, so both tiers are empty — the slabs can be
+        reallocated for the smaller record and the spill file (created
+        lazily) has never been written."""
+        # Batch dim 0 of the gather output is replicated; the per-page
+        # local index is the fetch index minus that dim.
+        self._kv_sharding = codes.sharding
+        self._local_index = tuple(f_idx[1:])
+        if scales is not None:
+            self._scales_sharding = scales.sharding
+            self._scales_index = tuple(fs_idx[1:])
+        with self._lock:
+            assert not self._host_lru and not self._spill_nodes, (
+                "slice mode armed after pages were parked")
+            self.codes_shape = tuple(fetched.shape[1:])
+            if fetched_s is not None:
+                self.scales_shape = tuple(fetched_s.shape[1:])
+            self._codes_bytes = int(np.prod(self.codes_shape)
+                                    * self.codes_dtype.itemsize)
+            self._scales_bytes = (int(np.prod(self.scales_shape) * 4)
+                                  if self.scales_shape else 0)
+            self._rec_bytes = self._codes_bytes + self._scales_bytes
+            n_host = max(0, self._host_budget_mb * (1 << 20)
+                         // self._rec_bytes)
+            self.n_host_slots = n_host
+            self._host_codes = np.zeros((n_host,) + self.codes_shape,
+                                        self.codes_dtype)
+            self._host_scales = (np.zeros((n_host,) + self.scales_shape,
+                                          np.float32)
+                                 if self.scales_shape else None)
+            self._host_free = list(range(n_host - 1, -1, -1))
+
     # -- promotion (scheduler thread, called from PagedPrefixCache) --------
 
     # graftlint: hot-path
@@ -308,9 +396,35 @@ class KVPager:
                 else:
                     raise RuntimeError(
                         f"promote of a tier-{node.tier} node")
-        pool = engine_model.pages_to_pool(
-            pool, self._put(codes),
-            None if scales is None else self._put(scales), self._put(row))
+        # Publish BEFORE the scatter launch (GL701): `keys` reference
+        # the pager_out records whose bytes each follower parked in
+        # its own per-host cold store.
+        log = self.mh_log
+        if log is not None:
+            log.publish(
+                "pager_in", row=row, n=np.int32(n),
+                keys=np.asarray([node.cold_key for node in nodes],
+                                np.int64))
+        if self._kv_sharding is not None:
+            from generativeaiexamples_tpu.serving.multihost import (
+                put_local_slice)
+
+            buf = put_local_slice(
+                codes, (slice(0, w),) + self._local_index,
+                (w,) + tuple(self._global_codes_shape), self._kv_sharding)
+            sbuf = None
+            if scales is not None:
+                sbuf = put_local_slice(
+                    scales, (slice(0, w),) + self._scales_index,
+                    (w,) + tuple(self._global_scales_shape),
+                    self._scales_sharding)
+            pool = engine_model.pages_to_pool(pool, buf, sbuf,
+                                              self._put(row))
+        else:
+            pool = engine_model.pages_to_pool(
+                pool, self._put(codes),
+                None if scales is None else self._put(scales),
+                self._put(row))
         with self._lock:
             for node, page in zip(nodes, pages):
                 self._free_cold_locked(node)
@@ -331,6 +445,11 @@ class KVPager:
         straight from its cold tier — no device scatter, no pool
         pressure). `codes_out[i]` / `scales_out[i]` receive node i's
         page; every node must be TIER_HOST or TIER_DISK."""
+        if self._kv_sharding is not None:
+            raise RuntimeError(
+                "read_pages under per-host slice mode: each rank's cold "
+                "tier holds only its addressable shard slice, which "
+                "cannot serve a disagg export of full pages")
         with self._lock:
             for i, node in enumerate(nodes):
                 if node.tier == TIER_HOST:

@@ -73,6 +73,16 @@ class MemoryPlan:
     axis_sizes: Dict[str, int] = field(default_factory=dict)
     devices_per_host: int = 1
     n_processes: int = 1
+    # KV pager host tier (host RAM, not HBM; zeros when kv_pager off).
+    # The budget is PER-HOST: under a cross-process mesh each rank's
+    # host/disk tiers park only its addressable shard slice of a page
+    # (kv_pager slice mode), so a host's cold record is the per-device
+    # page footprint times its local device count — N hosts together
+    # hold one full copy, and the fleet's total cold capacity scales
+    # with the host count at constant per-host RAM.
+    pager_host_budget_mb: int = 0
+    pager_rec_bytes_per_host: int = 0
+    pager_host_slots: int = 0
 
     @property
     def fixed_bytes_per_device(self) -> int:
@@ -115,7 +125,15 @@ class MemoryPlan:
             f"  ({b * self.devices_per_host / GiB:.3f} GiB/host)"
             + (f"  [{note}]" if note else "")
             for n, b, note in rows)
-        return hdr + "\n" + body
+        out = hdr + "\n" + body
+        if self.pager_host_budget_mb > 0:
+            out += (
+                f"\n  kv pager host tier (host RAM, per host): "
+                f"{self.pager_host_budget_mb} MiB budget -> "
+                f"{self.pager_host_slots} page slots x "
+                f"{self.pager_rec_bytes_per_host / (1 << 20):.2f} MiB "
+                f"local slice")
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -304,12 +322,24 @@ def plan_engine_memory(
     pool_pages = fit_pages if ecfg.prefix_cache else min(fit_pages,
                                                          default_pages)
 
+    # KV pager host-tier accounting (host RAM): one cold record per
+    # host is that host's slice of a page — per-device page bytes x
+    # local devices (exact for the slice mode kv_pager arms under
+    # cross-process meshes; equals the full page on one host).
+    pager_budget = int(ecfg.kv_host_budget_mb) if ecfg.kv_pager else 0
+    pager_rec = page * devices_per_host
+    pager_slots = ((pager_budget << 20) // pager_rec
+                   if pager_budget > 0 else 0)
+
     plan = MemoryPlan(
         lines=lines, hbm_bytes_per_device=hbm, headroom_bytes=headroom,
         page_bytes_per_device=page, fit_pages=int(fit_pages),
         pool_pages=int(pool_pages), default_pages=default_pages,
         axis_sizes=sizes, devices_per_host=devices_per_host,
-        n_processes=max(1, n_processes))
+        n_processes=max(1, n_processes),
+        pager_host_budget_mb=pager_budget,
+        pager_rec_bytes_per_host=int(pager_rec),
+        pager_host_slots=int(pager_slots))
     if strict and fit_pages < max_pages + 1:
         smaller = smallest_fitting_mesh(lcfg, ecfg, hbm)
         hint = (f"smallest mesh that fits: ici_tensor="

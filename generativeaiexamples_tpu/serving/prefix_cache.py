@@ -75,7 +75,7 @@ TIER_PENDING = 3
 
 class _Node:
     __slots__ = ("key", "page", "children", "parent", "last_used",
-                 "tier", "handle", "dev_children")
+                 "tier", "handle", "dev_children", "cold_key")
 
     def __init__(self, key, page, parent):
         self.key = key          # tuple of page_size token ids (root: None)
@@ -93,6 +93,10 @@ class _Node:
         self.tier = TIER_DEVICE
         self.handle = None
         self.dev_children = 0
+        # Multihost wire name stamped at demotion (kv_pager.demote):
+        # the id a pager_in record uses so follower ranks can find the
+        # bytes in their own per-host cold store. None until demoted.
+        self.cold_key = None
 
 
 class RadixTree:

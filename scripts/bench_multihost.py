@@ -22,10 +22,21 @@ this repo's multi-host bring-up actually ships:
                  gate results ride along so the artifact proves the
                  init path + replay lockstep, not just arithmetic.
                  BENCH_MULTIHOST_SIM=0 skips (CI runs it standalone).
+  4. features_serving — the serving leg again under the FULL profile
+                 the generalized replay protocol now carries
+                 (speculative tree + step plans + fused prefill +
+                 fused sampling + prefix cache + kv pager): the same
+                 past-the-bucket prompt set served twice through one
+                 engine. Keys: tok_s / tok_s_per_chip (both passes
+                 pooled), ttft_cold_p50_ms (pass 1, full prefill) vs
+                 ttft_warm_p50_ms (pass 2, prefix-cache promote),
+                 ttft_warm_speedup, prefix_hits — compare
+                 tok_s_per_chip and ttft_* against the plain `serving`
+                 leg for the feature win.
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/bench_multihost.py
-    python scripts/bench_multihost.py --out MULTICHIP_r06.json
+    python scripts/bench_multihost.py --out MULTICHIP_r07.json
 """
 
 from __future__ import annotations
@@ -50,16 +61,27 @@ import numpy as np  # noqa: E402
 GiB = float(1 << 30)
 
 
-def _engine_cfg(size: str):
+def _engine_cfg(size: str, features: bool = False):
     from generativeaiexamples_tpu.config.schema import EngineConfig
 
+    extra = dict(speculative_k=2, speculative_tree_branches=2,
+                 step_plans=True, fused_prefill=True, fused_sampling=True,
+                 prefix_cache=True, kv_pager=True) if features else {}
+    # With prefix_cache on, auto_pool_pages fills every spare device
+    # byte — on the CPU backend that is host RAM, and the resulting
+    # multi-million-page pool makes each scatter take ~40 s. The
+    # features leg uses the legacy worst-case sizing instead
+    # (max_batch_size * max_pages + 1), which is identical on any
+    # device where that bound fits.
+    auto = not features
     if size == "tiny":
         return EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
                             prefill_buckets=(16, 32),
                             pace_emission_max_streams=0,
-                            compile_cache_dir="", auto_pool_pages=True)
-    return EngineConfig(auto_pool_pages=True, pace_emission_max_streams=0,
-                        compile_cache_dir="")
+                            compile_cache_dir="", auto_pool_pages=auto,
+                            **extra)
+    return EngineConfig(auto_pool_pages=auto, pace_emission_max_streams=0,
+                        compile_cache_dir="", **extra)
 
 
 def _measured_hbm() -> int | None:
@@ -72,12 +94,12 @@ def _measured_hbm() -> int | None:
     return None
 
 
-def serving_leg(size: str, n_reqs: int, max_new: int) -> dict:
+def _build_serving_engine(size: str, features: bool = False):
     from generativeaiexamples_tpu.config.schema import MeshConfig
     from generativeaiexamples_tpu.models import llama
     from generativeaiexamples_tpu.parallel.mesh import build_mesh
     from generativeaiexamples_tpu.serving import sharding as shd
-    from generativeaiexamples_tpu.serving.engine import GenRequest, LLMEngine
+    from generativeaiexamples_tpu.serving.engine import LLMEngine
     from generativeaiexamples_tpu.utils.tokenizer import ByteTokenizer
 
     lcfg = {"tiny": llama.LlamaConfig.tiny,
@@ -89,16 +111,16 @@ def serving_leg(size: str, n_reqs: int, max_new: int) -> dict:
     params = llama.init_params(lcfg, jax.random.PRNGKey(0))
     if mesh is not None:
         params = shd.shard_llama_params(params, lcfg, mesh)
-    eng = LLMEngine(params, lcfg, ByteTokenizer(), _engine_cfg(size),
-                    mesh=mesh, use_pallas=False)
-    plan = eng.memory_plan
-    eng.warmup()
-    measured = _measured_hbm()
-    eng.start()
+    return LLMEngine(params, lcfg, ByteTokenizer(),
+                     _engine_cfg(size, features),
+                     mesh=mesh, use_pallas=False), mesh
 
-    prompt_len = 12 if size == "tiny" else 128
-    prompts = [[(13 * i + 5 * j) % 250 + 1 for j in range(prompt_len)]
-               for i in range(n_reqs)]
+
+def _run_batch(eng, prompts, max_new: int):
+    """Submit all `prompts`, drain every stream. Returns (ttfts,
+    n_tokens, wall_s)."""
+    from generativeaiexamples_tpu.serving.engine import GenRequest
+
     ttfts, t0 = [], time.perf_counter()
     n_tokens = 0
     reqs = []
@@ -118,7 +140,20 @@ def serving_leg(size: str, n_reqs: int, max_new: int) -> dict:
             if ev["finished"]:
                 break
         ttfts.append(first if first is not None else float("nan"))
-    wall = time.perf_counter() - t0
+    return ttfts, n_tokens, time.perf_counter() - t0
+
+
+def serving_leg(size: str, n_reqs: int, max_new: int) -> dict:
+    eng, mesh = _build_serving_engine(size)
+    plan = eng.memory_plan
+    eng.warmup()
+    measured = _measured_hbm()
+    eng.start()
+
+    prompt_len = 12 if size == "tiny" else 128
+    prompts = [[(13 * i + 5 * j) % 250 + 1 for j in range(prompt_len)]
+               for i in range(n_reqs)]
+    ttfts, n_tokens, wall = _run_batch(eng, prompts, max_new)
     eng.stop()
 
     n_dev = len(jax.devices())
@@ -139,6 +174,48 @@ def serving_leg(size: str, n_reqs: int, max_new: int) -> dict:
         "planner_vs_measured_pct": (
             round(100.0 * predicted / measured, 1)
             if predicted and measured else None),
+    }
+
+
+def features_serving_leg(size: str, n_reqs: int, max_new: int) -> dict:
+    """The serving leg under the full replayable profile: speculative
+    tree + step plans + fused prefill/sampling + prefix cache + kv
+    pager. The same past-the-bucket prompt set is served twice through
+    one engine — pass 1's TTFT is a full chunked prefill, pass 2's is
+    a prefix-cache promote, and the delta is the warm-resume win the
+    multihost pod path now gets too."""
+    eng, mesh = _build_serving_engine(size, features=True)
+    prompt_len = 48 if size == "tiny" else 192
+    eng.warmup(long_prompts=True, long_prompt_lengths=(prompt_len,))
+    eng.start()
+
+    prompts = [[(13 * i + 5 * j) % 250 + 1 for j in range(prompt_len)]
+               for i in range(n_reqs)]
+    cold_ttfts, n_cold, wall_cold = _run_batch(eng, prompts, max_new)
+    warm_ttfts, n_warm, wall_warm = _run_batch(eng, prompts, max_new)
+    snap = eng.metrics.snapshot()
+    eng.stop()
+
+    n_dev = len(jax.devices())
+    n_tokens, wall = n_cold + n_warm, wall_cold + wall_warm
+    cold_p50 = float(np.percentile(cold_ttfts, 50)) * 1e3
+    warm_p50 = float(np.percentile(warm_ttfts, 50)) * 1e3
+    return {
+        "size": size,
+        "n_devices": n_dev,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "requests": 2 * n_reqs,
+        "prompt_len": prompt_len,
+        "tokens_out": n_tokens,
+        "tok_s": round(n_tokens / wall, 2),
+        "tok_s_per_chip": round(n_tokens / wall / n_dev, 2),
+        "ttft_cold_p50_ms": round(cold_p50, 1),
+        "ttft_warm_p50_ms": round(warm_p50, 1),
+        "ttft_warm_speedup": (round(cold_p50 / warm_p50, 2)
+                              if warm_p50 > 0 else None),
+        "prefix_hits": int(snap["prefix_hits"]),
+        "fused_sample_dispatches": int(snap["fused_sample_dispatches"]),
+        "spec_tokens_per_step": snap["spec_tokens_per_step"],
     }
 
 
@@ -207,7 +284,7 @@ def cpu_sim_leg() -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "MULTICHIP_r06.json"))
+                                                  "MULTICHIP_r07.json"))
     ap.add_argument("--json", action="store_true",
                     help="print the artifact to stdout too")
     args = ap.parse_args()
@@ -225,6 +302,13 @@ def main() -> int:
                 f"TTFT p50 {serving['ttft_p50_ms']} ms, "
                 f"planner {serving['planner_predicted_bytes_per_device']} B"
                 f" vs measured {serving['measured_bytes_per_device']} B")
+    feat = features_serving_leg(size, n_reqs, max_new)
+    tail.append(f"[features_serving] {size}: "
+                f"{feat['tok_s_per_chip']} tok/s/chip, "
+                f"TTFT cold p50 {feat['ttft_cold_p50_ms']} ms vs warm "
+                f"{feat['ttft_warm_p50_ms']} ms "
+                f"(x{feat['ttft_warm_speedup']}), "
+                f"{feat['prefix_hits']} prefix hits")
     dry8 = dryrun_leg("8b")
     dry70 = dryrun_leg("70b")
     for d in (dry8, dry70):
@@ -240,6 +324,7 @@ def main() -> int:
                     f"failures={sim.get('failures')}")
 
     ok = (serving["tokens_out"] > 0
+          and feat["tokens_out"] > 0 and feat["prefix_hits"] > 0
           and dry8["fits"] and dry70["fits"]
           and dry8["fail_fast"].startswith("raised, ")
           and dry70["fail_fast"].startswith("raised, ")
@@ -251,6 +336,7 @@ def main() -> int:
         "skipped": False,
         "tail": "\n".join(tail) + "\n",
         "serving": serving,
+        "features_serving": feat,
         "dryrun_8b": dry8,
         "dryrun_70b": dry70,
         "cpu_sim": sim,

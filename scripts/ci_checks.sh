@@ -80,7 +80,11 @@
 #                   distributed init, follower replay lockstep, streams
 #                   byte-identical to a single-process TP=2 engine,
 #                   planner-sized page pool + live gauges, stop record
-#                   exits the follower cleanly).
+#                   exits the follower cleanly; plus the features-on
+#                   leg — speculative tree + step plans + fused
+#                   prefill/sampling + prefix cache + kv pager all
+#                   replaying byte-identically, warm-turn prefix hit,
+#                   zero replay divergences on either rank).
 #  14. tier-1 tests — the ROADMAP.md pytest gate.
 
 set -u -o pipefail

@@ -295,11 +295,9 @@ class TestFusedWarmup:
         # An unwarmed scratch shape must NOT fuse (falls back to the
         # interleaved lane instead of compiling mid-traffic).
         from generativeaiexamples_tpu.serving.engine import _LongPrefill
-        from generativeaiexamples_tpu.models.llama import KVCache
 
         lp = _LongPrefill(GenRequest(prompt_ids=[1] * 100), 0, None,
-                          [1] * 100, KVCache.zeros(TINY, 1, max_len=112),
-                          None, 16)
+                          [1] * 100, 112, None, 16)
         assert not eng._fuse_ready(lp)
 
     def test_fused_metrics_always_present_in_snapshot(self):

@@ -828,8 +828,11 @@ class TestHotPathInference:
         from generativeaiexamples_tpu.lint.core import load_project
 
         pre_pr_hot_defaults = {
+            # _dispatch_plan became _exec_plan when the dispatch
+            # helpers were recast as multihost record executors; the
+            # pin follows the rename (same dispatch site).
             "engine.py": {"_loop", "_admit_waiting", "_dispatch_decode",
-                          "_select_plan", "_dispatch_plan",
+                          "_select_plan", "_exec_plan",
                           "_rider_candidate", "_advance_long_prefills",
                           "_emit_ready_first_tokens", "_qos_pop_waiting",
                           "_qos_refresh_preemption",

@@ -283,12 +283,10 @@ class TestPlanWarmupLattice:
         assert eng.metrics.snapshot()["plan_variants_compiled"] \
             == len(eng._warm_plans)
         # Unwarmed scratch shape: the rider is dropped, not compiled.
-        from generativeaiexamples_tpu.models.llama import KVCache
         from generativeaiexamples_tpu.serving.engine import _LongPrefill
 
         lp = _LongPrefill(GenRequest(prompt_ids=[1] * 100), 0, None,
-                          [1] * 100, KVCache.zeros(TINY, 1, max_len=112),
-                          None, 16)
+                          [1] * 100, 112, None, 16)
         assert not eng._fuse_ready(lp)
         eng._long_prefills.append(lp)
         eng.slots[0] = lp.slot  # None is lp.slot -> candidate filter
